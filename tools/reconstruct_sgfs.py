@@ -1,0 +1,115 @@
+"""Reconstruct SGF game files from the reference's bundled per-move records.
+
+The reference repo (wqzsscc/deep-go) bundles a mini-dataset of transcribed
+positions (one torch-serialized file per move; see reference makedata.lua:537-559)
+but not the source SGF files. Each record stores the move that was played
+(player, x, y), the pre-move board, and both player ranks — which is everything
+needed to rebuild the original game script:
+
+  * moves:      record k's ``move`` field, for k = 1..N
+  * handicaps:  the stones already on the board in record 1; their placement
+    order is recovered from the age plane (the reference places handicap
+    stones sequentially through update_board, makedata.lua:173-175, so the
+    i-th placed of H stones carries age H-i+1 in record 1)
+  * ranks:      record 1's ``ranks`` field (reference get_ranks, makedata.lua:102)
+
+The reconstructed SGFs are committed under data/sgf/ and serve as the seed
+corpus for this framework's own transcription pipeline; golden tests then
+require our pipeline's packed planes to match the reference records bit-exact.
+
+Usage: python tools/reconstruct_sgfs.py [--reference /root/reference/data] [--out data/sgf]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import t7reader  # noqa: E402
+
+# Plane indices within the packed 9-channel record (0-based; the layout is
+# fixed by reference dataloader.lua:20-27).
+STONES, AGE = 0, 6
+
+_COORD_CHARS = "abcdefghijklmnopqrs"
+
+
+def _coord(x: int, y: int) -> str:
+    """1-based board coordinates -> SGF two-letter coordinate."""
+    return _COORD_CHARS[x - 1] + _COORD_CHARS[y - 1]
+
+
+def reconstruct_game(game_dir: str) -> str:
+    """Rebuild a single game's SGF text from its per-move record directory."""
+    n_moves = len([f for f in os.listdir(game_dir) if f.isdigit()])
+    first = t7reader.load(os.path.join(game_dir, "1"))
+    ranks = first["ranks"]
+
+    # Handicap stones: present on the pre-move-1 board, ordered by descending
+    # age so that replaying them reproduces the reference's age plane.
+    planes = first["input"]
+    stones, ages = planes[STONES], planes[AGE]
+    handicaps = []
+    for x in range(19):
+        for y in range(19):
+            if stones[x][y]:
+                handicaps.append((int(ages[x][y]), int(stones[x][y]), x + 1, y + 1))
+    handicaps.sort(key=lambda h: -h[0])
+
+    # One property per line, CRLF line endings: this keeps the files readable
+    # by the reference's line-oriented parser (split_sgf/handicaps/get_ranks
+    # split on literal "\r\n" and accept only one X[v] token per piece,
+    # makedata.lua:24-58,102-120) in addition to our own parser.
+    lines = ["(;GM[1]", "FF[4]", "CA[UTF-8]", "SZ[19]",
+             f"BR[{int(ranks[1])}d]", f"WR[{int(ranks[2])}d]"]
+
+    # Emit handicap stones in placement order, as runs of consecutive
+    # same-player stones (one AB/AW property line per run). Grouping all AB
+    # before all AW would lose cross-player placement order and break the
+    # age-plane reconstruction for interleaved setup stones.
+    run_player, run_coords = None, []
+    for _, p, x, y in handicaps + [(0, None, 0, 0)]:
+        if p != run_player:
+            if run_coords:
+                lines.append(("AB" if run_player == 1 else "AW")
+                             + "".join(f"[{c}]" for c in run_coords))
+            run_player, run_coords = p, []
+        if p is not None:
+            run_coords.append(_coord(x, y))
+
+    for k in range(1, n_moves + 1):
+        move = t7reader.load(os.path.join(game_dir, str(k)))["move"]
+        tag = "B" if move["player"] == 1 else "W"
+        lines.append(f";{tag}[{_coord(int(move['x']), int(move['y']))}]")
+
+    return "\r\n".join(lines) + ")\r\n"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default="/root/reference/data")
+    ap.add_argument("--out", default="data/sgf")
+    args = ap.parse_args()
+
+    for split in ("train", "validation", "test"):
+        split_dir = os.path.join(args.reference, split)
+        for root, dirs, _files in os.walk(split_dir):
+            for d in sorted(dirs):
+                game_dir = os.path.join(root, d)
+                if not os.path.isfile(os.path.join(game_dir, "1")):
+                    continue
+                rel = os.path.relpath(game_dir, split_dir)
+                out_path = os.path.join(args.out, split, rel)
+                if not out_path.endswith(".sgf"):
+                    out_path += ".sgf"
+                os.makedirs(os.path.dirname(out_path), exist_ok=True)
+                sgf = reconstruct_game(game_dir)
+                with open(out_path, "w") as f:
+                    f.write(sgf)
+                print(f"{out_path}: {sgf.count(';') - 1} moves")
+
+
+if __name__ == "__main__":
+    main()
