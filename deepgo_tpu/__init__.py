@@ -1,0 +1,13 @@
+"""deepgo_tpu — a TPU-native (JAX/XLA/Pallas) Go move-prediction framework.
+
+Re-implements the capabilities of the reference Torch7 codebase
+(wqzsscc/deep-go, mounted at /root/reference) with a TPU-first design:
+packed uint8 feature records expanded to model planes on-device inside the
+jitted train step, a functional conv policy network, data parallelism via
+``jax.sharding`` over a device mesh, and a native C++ transcription engine.
+"""
+
+__version__ = "0.1.0"
+
+BOARD_SIZE = 19
+NUM_POINTS = BOARD_SIZE * BOARD_SIZE

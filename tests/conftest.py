@@ -1,0 +1,27 @@
+"""Test configuration.
+
+JAX-related env vars must be set before jax is first imported anywhere, so
+they are set here at conftest import time: tests run on the CPU backend with
+8 virtual devices, the TPU-native analogue of testing multi-device code
+without a cluster (SURVEY.md section 4).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+REFERENCE_DATA = "/root/reference/data"
+
+
+def reference_available() -> bool:
+    return os.path.isdir(REFERENCE_DATA)

@@ -1,0 +1,70 @@
+"""Feature schema tests: packed record -> 37 expanded model planes."""
+
+import numpy as np
+import pytest
+
+from deepgo_tpu import features
+from deepgo_tpu.go import new_board, play, summarize
+
+
+def _sample_packed():
+    stones, age = new_board()
+    moves = [(3, 3, 1), (15, 15, 2), (3, 4, 1), (15, 16, 2), (16, 16, 1)]
+    for x, y, p in moves:
+        play(stones, age, x, y, p)
+    return summarize(stones, age)
+
+
+@pytest.mark.parametrize("player", [1, 2])
+def test_expand_shapes_and_binarity(player):
+    packed = _sample_packed()
+    planes = features.expand_planes_np(packed, player=player, rank=5)
+    assert planes.shape == (37, 19, 19)
+    assert set(np.unique(planes)) <= {0.0, 1.0}
+
+
+def test_stone_planes_perspective():
+    packed = _sample_packed()
+    for player in (1, 2):
+        planes = features.expand_planes_np(packed, player=player, rank=1)
+        stones = packed[features.P_STONES]
+        assert np.array_equal(planes[0], (stones == 0).astype(np.float32))
+        assert np.array_equal(planes[1], (stones == player).astype(np.float32))
+        assert np.array_equal(planes[2], (stones == 3 - player).astype(np.float32))
+        # the three stone planes partition the board
+        assert np.array_equal(planes[0] + planes[1] + planes[2], np.ones((19, 19)))
+
+
+def test_rank_planes_one_hot():
+    packed = _sample_packed()
+    for rank in range(1, 10):
+        planes = features.expand_planes_np(packed, player=1, rank=rank)
+        rank_planes = planes[features.X_RANK_BASE:]
+        assert rank_planes.shape[0] == 10  # base plane + 9 rank planes
+        assert np.array_equal(rank_planes.sum(axis=(1, 2)) > 0,
+                              np.arange(10) == rank)
+        # the base plane (reference's unused RANK slot) is always zero
+        assert planes[features.X_RANK_BASE].sum() == 0
+
+
+def test_liberties_after_zero_plane_masked_to_empty():
+    # plane X_LIB_AFTER is (empty AND lib_after == 0): occupied points have
+    # lib_after 0 in the packed record but must not fire the plane.
+    packed = _sample_packed()
+    planes = features.expand_planes_np(packed, player=1, rank=3)
+    stones = packed[features.P_STONES]
+    assert planes[features.X_LIB_AFTER][stones != 0].sum() == 0
+
+
+def test_age_planes_exact_match_only():
+    packed = _sample_packed()
+    planes = features.expand_planes_np(packed, player=1, rank=3)
+    age = packed[features.P_AGE]
+    for i in range(5):
+        assert np.array_equal(planes[features.X_AGE + i], (age == i + 1).astype(np.float32))
+
+
+def test_target_index():
+    assert features.target_index(0, 0) == 0
+    assert features.target_index(18, 18) == 360
+    assert features.target_index(1, 0) == 19

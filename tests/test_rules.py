@@ -1,0 +1,233 @@
+"""Go rules engine unit tests on hand-written positions.
+
+These cover the paths the bundled fixture corpus cannot: handicap aging,
+suicide, multi-chain captures, ladder success/failure with breakers, and the
+exact liberties-after/kills semantics at board edges.
+"""
+
+import numpy as np
+import pytest
+
+from deepgo_tpu.go import (
+    BLACK,
+    EMPTY,
+    WHITE,
+    IllegalMoveError,
+    group_and_liberties,
+    ladder_moves,
+    new_board,
+    play,
+    simulate_play,
+    summarize,
+)
+from deepgo_tpu.go.summarize import kills_and_liberties_after, ladders_and_liberties
+from deepgo_tpu.go.board import find_groups
+
+
+def board_from(rows):
+    """Build a stones array from strings of '.XO' (row index = x)."""
+    stones, _ = new_board()
+    for x, row in enumerate(rows):
+        for y, c in enumerate(row):
+            stones[x, y] = {".": EMPTY, "X": BLACK, "O": WHITE}[c]
+    return stones
+
+
+def test_single_stone_liberties():
+    stones = board_from(["X" + "." * 18] + ["." * 19] * 18)
+    _, libs = group_and_liberties(stones, 0, 0)
+    assert len(libs) == 2  # corner stone
+    stones[9, 9] = WHITE
+    _, libs = group_and_liberties(stones, 9, 9)
+    assert len(libs) == 4  # center stone
+
+
+def test_chain_merging_liberties():
+    stones, _ = new_board()
+    for y in (3, 4, 5):
+        stones[3, y] = BLACK
+    group, libs = group_and_liberties(stones, 3, 4)
+    assert len(group) == 3
+    assert len(libs) == 8
+
+
+def test_capture_single_stone():
+    stones, age = new_board()
+    play(stones, age, 5, 5, WHITE)
+    for x, y in ((4, 5), (6, 5), (5, 4)):
+        play(stones, age, x, y, BLACK)
+    assert stones[5, 5] == WHITE
+    kills = play(stones, age, 5, 6, BLACK)
+    assert kills == 1
+    assert stones[5, 5] == EMPTY
+    assert age[5, 5] == 1  # freed point restarts its age clock
+
+
+def test_multi_chain_capture_explicit():
+    # Two separate white chains share their final liberty at p; one black
+    # move captures both.
+    stones, age = new_board()
+    # chain A: (0,0); chain B: (2,0); both bordered so that (1,0) is last lib
+    stones[0, 0] = WHITE
+    stones[2, 0] = WHITE
+    stones[0, 1] = BLACK
+    stones[2, 1] = BLACK
+    stones[3, 0] = BLACK
+    kills = play(stones, age, 1, 0, BLACK)
+    assert kills == 2
+    assert stones[0, 0] == EMPTY and stones[2, 0] == EMPTY
+    assert stones[1, 0] == BLACK
+
+
+def test_suicide_removes_own_chain():
+    # Point (0,0) surrounded by white: black playing there is suicide and
+    # the black stone is removed (reference play_with_f applies the dead
+    # check to the played chain too, makedata.lua:234-241).
+    stones, age = new_board()
+    stones[0, 1] = WHITE
+    stones[1, 0] = WHITE
+    stones[1, 1] = WHITE  # give whites liberties
+    kills = play(stones, age, 0, 0, BLACK)
+    assert kills == 0
+    assert stones[0, 0] == EMPTY
+    assert age[0, 0] == 1
+
+
+def test_simulate_play_restores_board():
+    stones, _ = new_board()
+    stones[0, 1] = BLACK
+    stones[1, 0] = BLACK
+    stones[0, 0] = WHITE  # white in atari at corner
+    before = stones.copy()
+    kills, libs = simulate_play(stones, 1, 1, BLACK)
+    assert kills == 0
+    assert np.array_equal(stones, before)
+    # black capturing the corner: play at ... corner stone's last liberty is (1,1)? neighbors of (0,0): (0,1)B,(1,0)B -> 0 libs already; instead:
+    stones[0, 0] = EMPTY
+    stones[1, 1] = WHITE
+    before = stones.copy()
+    kills, libs = simulate_play(stones, 0, 0, WHITE)
+    assert np.array_equal(stones, before)
+
+
+def test_kills_and_liberties_after_capture_frees_points():
+    # White stone at (0,0) in atari; black playing its last liberty captures
+    # it and the freed point counts as a liberty of the capturing chain.
+    stones, _ = new_board()
+    stones[0, 0] = WHITE
+    stones[0, 1] = BLACK
+    kills, libs_after = simulate_play(stones, 1, 0, BLACK)
+    assert kills == 1
+    # new black stone at (1,0): neighbors (0,0) freed, (2,0), (1,1); chain
+    # merges with nothing.
+    assert libs_after == 3
+
+
+def test_illegal_move_raises():
+    stones, age = new_board()
+    play(stones, age, 3, 3, BLACK)
+    with pytest.raises(IllegalMoveError):
+        play(stones, age, 3, 3, WHITE)
+
+
+def test_age_semantics():
+    stones, age = new_board()
+    play(stones, age, 0, 0, BLACK)
+    play(stones, age, 5, 5, WHITE)
+    play(stones, age, 10, 10, BLACK)
+    assert age[0, 0] == 3 and age[5, 5] == 2 and age[10, 10] == 1
+    assert age[1, 1] == 0  # untouched empty points stay at 0
+
+
+def test_handicap_aging_matches_sequential_placement():
+    # Handicap stones are placed through the same path as moves, so the
+    # i-th of H stones has age H-i+1 once all are down.
+    from deepgo_tpu import sgf
+    from deepgo_tpu.go import replay_positions
+
+    game = sgf.parse("(;BR[9d]WR[9d]AB[pd][dp][pp];B[dd])")
+    packed, move = next(replay_positions(game))
+    age = packed[6]
+    assert age[15, 3] == 3 and age[3, 15] == 2 and age[15, 15] == 1
+
+
+def test_fast_path_matches_simulation():
+    # kills_and_liberties_after's no-capture fast path must agree with the
+    # full simulation everywhere on a busy random board.
+    rng = np.random.default_rng(0)
+    stones, age = new_board()
+    for _ in range(120):
+        x, y = rng.integers(0, 19, size=2)
+        if stones[x, y] == EMPTY:
+            play(stones, age, int(x), int(y), int(rng.integers(1, 3)))
+    labels, groups = find_groups(stones)
+    kills, lib_after = kills_and_liberties_after(stones, labels, groups)
+    for x in range(19):
+        for y in range(19):
+            if stones[x, y] != EMPTY:
+                assert kills[0, x, y] == 0 and lib_after[1, x, y] == 0
+                continue
+            for player in (1, 2):
+                k, la = simulate_play(stones, x, y, player)
+                assert kills[player - 1, x, y] == min(k, 255), (x, y, player)
+                assert lib_after[player - 1, x, y] == min(la, 255), (x, y, player)
+
+
+def _ladder_board():
+    """Classic working ladder: white stone at (2,2) with two liberties,
+    hemmed by black so every escape leaves exactly two liberties and the
+    chase staircases toward the far corner."""
+    stones, _ = new_board()
+    stones[2, 2] = WHITE
+    stones[1, 2] = BLACK
+    stones[2, 1] = BLACK
+    stones[1, 3] = BLACK
+    return stones
+
+
+def test_ladder_capture_works_toward_corner():
+    stones = _ladder_board()
+    _, libs = group_and_liberties(stones, 2, 2)
+    assert sorted(libs) == [(2, 3), (3, 2)]
+    moves = ladder_moves(stones, 2, 2, libs)
+    # only the (3,2) chase works: chasing from (2,3) leaves the chasing
+    # stone itself with too few liberties (the > 2 guard).
+    assert moves == [(3, 2)]
+    # board restored after the search
+    assert stones[2, 2] == WHITE and int((stones > 0).sum()) == 4
+
+
+def test_ladder_breaker_defeats_ladder():
+    stones = _ladder_board()
+    # a white "ladder breaker" stone on the diagonal escape path
+    stones[10, 10] = WHITE
+    _, libs = group_and_liberties(stones, 2, 2)
+    moves = ladder_moves(stones, 2, 2, libs)
+    assert moves == []
+
+
+def test_ladders_plane_marks_chaser():
+    stones = _ladder_board()
+    ladders, liberties = ladders_and_liberties(stones)
+    # chased chain is white (player 2) of size 1 -> chasing player is black
+    # (index 0), marked with the chased-chain size at the working move.
+    assert int(ladders[0].sum()) == 1
+    assert ladders[0, 3, 2] == 1
+    assert int(ladders[1].sum()) == 0
+    assert liberties[2, 2] == 2
+    assert liberties[1, 2] == 5  # chain {(1,2),(1,3)}
+    assert liberties[1, 3] == 5
+    assert liberties[2, 1] == 3  # lone stone beside the white chain
+
+
+def test_summarize_packed_layout():
+    stones, age = new_board()
+    play(stones, age, 3, 3, BLACK)
+    packed = summarize(stones, age)
+    assert packed.shape == (9, 19, 19) and packed.dtype == np.uint8
+    assert packed[0, 3, 3] == BLACK
+    assert packed[1, 3, 3] == 4
+    assert packed[6, 3, 3] == 1
+    # liberties-after for black at an adjacent point merges with the chain
+    assert packed[2, 3, 4] == 6  # black plays (3,4): chain of 2, 6 liberties
+    assert packed[3, 3, 4] == 3  # white plays (3,4): single stone, 3 libs

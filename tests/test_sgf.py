@@ -1,0 +1,55 @@
+"""SGF parser unit tests."""
+
+from deepgo_tpu import sgf
+
+
+def test_basic_moves():
+    game = sgf.parse("(;GM[1]FF[4]SZ[19]BR[9d]WR[3d];B[pd];W[dd];B[pq])")
+    assert [(m.player, m.x, m.y) for m in game.moves] == [
+        (1, 15, 3),
+        (2, 3, 3),
+        (1, 15, 16),
+    ]
+    assert game.ranks == (9, 3)
+    assert game.handicaps == []
+
+
+def test_multiline_and_crlf():
+    text = "(;GM[1]\r\nFF[4]\r\nBR[5d]\r\nWR[5d]\r\n;B[aa]\r\n;W[ss])"
+    game = sgf.parse(text)
+    assert [(m.x, m.y) for m in game.moves] == [(0, 0), (18, 18)]
+    assert game.ranks == (5, 5)
+
+
+def test_passes_dropped():
+    # Empty value and 'tt' are both passes on 19x19.
+    game = sgf.parse("(;BR[1d]WR[1d];B[pd];W[];B[tt];W[dd])")
+    assert [(m.player, m.x, m.y) for m in game.moves] == [(1, 15, 3), (2, 3, 3)]
+
+
+def test_handicap_order_preserved():
+    game = sgf.parse("(;BR[2d]WR[2d]AB[pd][dp]AW[dd]AB[pp];B[qq])")
+    assert [(m.player, m.x, m.y) for m in game.handicaps] == [
+        (1, 15, 3),
+        (1, 3, 15),
+        (2, 3, 3),
+        (1, 15, 15),
+    ]
+
+
+def test_ranks_rejected():
+    # Kyu ranks, missing ranks, and out-of-range dan ranks disqualify a game,
+    # mirroring the reference's get_ranks/to_rank gate (makedata.lua:92-120).
+    assert sgf.parse("(;BR[5k]WR[1d];B[aa])").ranks is None
+    assert sgf.parse("(;BR[1d];B[aa])").ranks is None
+    assert sgf.parse("(;BR[12d]WR[1d];B[aa])").ranks is None
+
+
+def test_escaped_bracket_in_comment():
+    game = sgf.parse("(;BR[9d]WR[9d]C[a \\] tricky comment];B[cc])")
+    assert [(m.x, m.y) for m in game.moves] == [(2, 2)]
+
+
+def test_property_values_accumulate():
+    game = sgf.parse("(;AB[aa][bb]AB[cc];B[dd])")
+    assert len(game.handicaps) == 3
