@@ -1,0 +1,3 @@
+"""Datasets, transcription, sampling, and the async input pipeline."""
+
+from .dataset import GoDataset  # noqa: F401

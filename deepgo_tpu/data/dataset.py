@@ -1,0 +1,135 @@
+"""On-disk dataset format and random-access sampling.
+
+Replaces the reference's one-torch-file-per-move layout plus
+``<split>_game_counts.txt`` index (reference data.lua:53-80,
+count_game_moves.sh) with a TPU-friendly memory-mapped shard per split:
+
+  <root>/<split>/planes.bin   raw uint8, N x 9 x 19 x 19 packed records
+  <root>/<split>/meta.npy     int32 (N, 6): player, x, y, black_rank,
+                              white_rank, game_id
+  <root>/<split>/games.json   ordered list of {name, start, count}
+
+One 3.2 KB read per sampled position (memmap, zero-copy into the batch)
+instead of open+deserialize of a torch file; the expensive 37-plane
+expansion happens on device (deepgo_tpu.ops.expand).
+
+Sampling schemes:
+  * ``game``     uniform game, then uniform move within it — exact parity
+    with the reference (data.lua:29-37), which oversamples moves from short
+    games relative to the position-uniform distribution.
+  * ``uniform``  uniform over positions (the corrected option,
+    SURVEY.md section 7.6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..features import PACKED_CHANNELS
+from .. import BOARD_SIZE
+
+RECORD_SHAPE = (PACKED_CHANNELS, BOARD_SIZE, BOARD_SIZE)
+RECORD_BYTES = int(np.prod(RECORD_SHAPE))
+
+# meta columns
+M_PLAYER, M_X, M_Y, M_BLACK_RANK, M_WHITE_RANK, M_GAME = range(6)
+META_COLS = 6
+
+
+class GoDataset:
+    """Random-access view over one transcribed split."""
+
+    def __init__(self, root: str, split: str):
+        self.dir = os.path.join(root, split)
+        planes_path = os.path.join(self.dir, "planes.bin")
+        if not os.path.exists(planes_path):
+            raise FileNotFoundError(
+                f"no transcribed data at {self.dir} — run "
+                f"python -m deepgo_tpu.data.transcribe first"
+            )
+        self.meta = np.load(os.path.join(self.dir, "meta.npy"))
+        n = self.meta.shape[0]
+        self.planes = np.memmap(planes_path, dtype=np.uint8, mode="r",
+                                shape=(n, *RECORD_SHAPE))
+        with open(os.path.join(self.dir, "games.json")) as f:
+            games = json.load(f)
+        self.game_names = [g["name"] for g in games]
+        # (G, 2) start/count — games with zero moves are excluded at
+        # transcription time (the reference filters them at load, data.lua:74)
+        self.game_ranges = np.array([[g["start"], g["count"]] for g in games],
+                                    dtype=np.int64)
+        assert (self.game_ranges[:, 1] > 0).all()
+
+    def __len__(self) -> int:
+        return int(self.meta.shape[0])
+
+    @property
+    def num_games(self) -> int:
+        return len(self.game_names)
+
+    def sample_indices(self, rng: np.random.Generator, n: int,
+                       scheme: str = "game") -> np.ndarray:
+        if scheme == "uniform":
+            return rng.integers(0, len(self), size=n)
+        if scheme == "game":
+            games = rng.integers(0, self.num_games, size=n)
+            starts = self.game_ranges[games, 0]
+            counts = self.game_ranges[games, 1]
+            return starts + (rng.random(n) * counts).astype(np.int64)
+        raise ValueError(f"unknown sampling scheme {scheme!r}")
+
+    def batch_at(self, indices: np.ndarray):
+        """Gather (packed_planes, to_move_player, rank_of_player, target)."""
+        packed = self.planes[indices]  # (B, 9, 19, 19) uint8 copy
+        meta = self.meta[indices]
+        player = meta[:, M_PLAYER]
+        rank = np.where(player == 1, meta[:, M_BLACK_RANK], meta[:, M_WHITE_RANK])
+        target = meta[:, M_X] * BOARD_SIZE + meta[:, M_Y]
+        return packed, player.astype(np.int32), rank.astype(np.int32), target.astype(np.int32)
+
+    def sample_batch(self, rng: np.random.Generator, n: int, scheme: str = "game"):
+        return self.batch_at(self.sample_indices(rng, n, scheme))
+
+    def first_n(self, n: int):
+        """Deterministic prefix batch (fixed validation sets)."""
+        return self.batch_at(np.arange(min(n, len(self))))
+
+
+class DatasetWriter:
+    """Streaming writer for one split: append games, then finalize."""
+
+    def __init__(self, out_dir: str):
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self._planes_f = open(os.path.join(out_dir, "planes.bin.tmp"), "wb")
+        self._meta: list[np.ndarray] = []
+        self._games: list[dict] = []
+        self._count = 0
+
+    def add_game(self, name: str, packed: np.ndarray, meta: np.ndarray) -> None:
+        """packed: (M, 9, 19, 19) uint8; meta: (M, 6) int32 with game_id
+        column ignored (rewritten to this game's index)."""
+        m = packed.shape[0]
+        if m == 0:
+            return
+        assert packed.dtype == np.uint8 and packed.shape[1:] == RECORD_SHAPE
+        meta = meta.astype(np.int32, copy=True)
+        meta[:, M_GAME] = len(self._games)
+        self._planes_f.write(packed.tobytes())
+        self._meta.append(meta)
+        self._games.append({"name": name, "start": self._count, "count": m})
+        self._count += m
+
+    def finalize(self) -> int:
+        self._planes_f.close()
+        os.replace(os.path.join(self.out_dir, "planes.bin.tmp"),
+                   os.path.join(self.out_dir, "planes.bin"))
+        meta = (np.concatenate(self._meta) if self._meta
+                else np.zeros((0, META_COLS), dtype=np.int32))
+        np.save(os.path.join(self.out_dir, "meta.npy"), meta)
+        with open(os.path.join(self.out_dir, "games.json"), "w") as f:
+            json.dump(self._games, f)
+        return self._count

@@ -1,0 +1,3 @@
+"""Model zoo: policy CNNs for 19x19 move prediction."""
+
+from .policy_cnn import ModelConfig, apply, init, num_params  # noqa: F401

@@ -1,0 +1,114 @@
+"""Convolutional policy network for Go move prediction.
+
+The reference architecture (getBasicModel, reference experiments.lua:133-153):
+``num_layers`` SAME-padded convolutions — 5x5 on the 37 input planes first,
+then 3x3 — each followed by a *per-position, per-channel* bias (the
+Reshape/Add/Reshape sandwich at experiments.lua:143-145) and ReLU; the last
+convolution emits 1 channel whose 361 values feed a log-softmax.
+
+Functional JAX design: ``init`` builds a params pytree, ``apply`` is a pure
+function of (params, planes) -> logits, jit/vmap/grad-compatible. Compute
+runs in bfloat16 (MXU-native) with float32 parameters; the loss upcasts.
+
+One deliberate deviation, off by default: the reference applies ReLU to the
+final 1-channel conv as well (its layer loop is uniform), clamping logits to
+be non-negative before the softmax. ``final_relu=True`` reproduces that;
+the default skips it, which is both the paper's architecture
+(arXiv:1412.6564) and strictly more expressive.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import BOARD_SIZE, NUM_POINTS
+from ..features import NUM_PLANES
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """num_layers counts every convolution including the final 1-channel one,
+    matching the reference's numLayers (experiments.lua:39,88-94)."""
+
+    num_layers: int = 3
+    channels: int = 64
+    first_kernel: int = 5
+    kernel: int = 3
+    input_planes: int = NUM_PLANES
+    final_relu: bool = False  # True = bit-parity with the reference head
+    compute_dtype: str = "bfloat16"
+
+    def layer_shapes(self):
+        """[(kernel, c_in, c_out)] for each conv layer."""
+        shapes = []
+        c_in = self.input_planes
+        for i in range(self.num_layers):
+            k = self.first_kernel if i == 0 else self.kernel
+            c_out = 1 if i == self.num_layers - 1 else self.channels
+            shapes.append((k, c_in, c_out))
+            c_in = c_out
+        return shapes
+
+
+# Named flagship configurations (BASELINE.md benchmark configs).
+CONFIGS = {
+    "small": ModelConfig(num_layers=3, channels=64),
+    "medium": ModelConfig(num_layers=6, channels=64),
+    "full": ModelConfig(num_layers=12, channels=128),  # Maddison et al. scale
+    "large": ModelConfig(num_layers=13, channels=256),  # AlphaGo SL-policy scale
+}
+
+
+def init(rng: jax.Array, cfg: ModelConfig) -> dict:
+    """He-normal conv weights, zero per-position biases.
+
+    (The reference uses Torch's uniform 1/sqrt(fan-in) init; He init is the
+    modern equivalent for ReLU stacks and trains strictly better.)
+    """
+    params = {"layers": []}
+    for k, c_in, c_out in cfg.layer_shapes():
+        rng, wkey = jax.random.split(rng)
+        fan_in = k * k * c_in
+        w = jax.random.normal(wkey, (k, k, c_in, c_out), jnp.float32)
+        w = w * np.sqrt(2.0 / fan_in)
+        b = jnp.zeros((BOARD_SIZE, BOARD_SIZE, c_out), jnp.float32)
+        params["layers"].append({"w": w, "b": b})
+    return params
+
+
+def apply(params: dict, planes: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """planes: (B, 19, 19, 37) -> logits (B, 361).
+
+    Every conv is SAME-padded so the board never shrinks (the reference
+    zero-pads explicitly, experiments.lua:137). Softmax/NLL live in the loss
+    (training) or the serving wrapper, not here.
+    """
+    dtype = jnp.dtype(cfg.compute_dtype)
+    x = planes.astype(dtype)
+    n_layers = len(params["layers"])
+    for i, layer in enumerate(params["layers"]):
+        x = jax.lax.conv_general_dilated(
+            x,
+            layer["w"].astype(dtype),
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        x = x + layer["b"].astype(dtype)[None]
+        if i < n_layers - 1 or cfg.final_relu:
+            x = jax.nn.relu(x)
+    return x.reshape(x.shape[0], NUM_POINTS).astype(jnp.float32)
+
+
+def log_policy(params: dict, planes: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Log-probabilities over the 361 board points (the reference model's
+    actual output, experiments.lua:150-151)."""
+    return jax.nn.log_softmax(apply(params, planes, cfg), axis=-1)
+
+
+def num_params(params: dict) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

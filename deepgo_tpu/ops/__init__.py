@@ -1,0 +1,3 @@
+"""Device-side ops: feature expansion, model kernels, Pallas kernels."""
+
+from .expand import expand_planes  # noqa: F401

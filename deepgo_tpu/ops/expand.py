@@ -1,0 +1,51 @@
+"""On-device expansion of packed records into the 37 model input planes.
+
+The reference expands per-sample on host worker threads (preprocess,
+dataloader.lua:50-92), paying ~54 KB of float traffic per board. Here the
+host ships the 3.2 KB packed uint8 record and this jit-friendly function
+expands it on device as part of the train/inference step, where XLA fuses
+the comparisons into the surrounding program. Semantics match
+``deepgo_tpu.features.expand_planes_np`` exactly (tested against it).
+
+Layout: returns NHWC (batch, 19, 19, 37) — channels-last is the natural
+layout for TPU convolutions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..features import NUM_PLANES, PACKED_CHANNELS  # noqa: F401  (doc cross-ref)
+
+
+def expand_planes(packed, player, rank, dtype=jnp.bfloat16):
+    """packed: (B, 9, 19, 19) uint8; player, rank: (B,) int32.
+
+    Returns (B, 19, 19, 37) binary planes in ``dtype`` from the to-move
+    player's perspective.
+    """
+    packed = packed.astype(jnp.int32)
+    p3 = player[:, None, None]  # broadcast over the board
+    stones = packed[:, 0]
+    libs = packed[:, 1]
+    age = packed[:, 6]
+    # per-player packed channels, selected by the player to move
+    is_black = p3 == 1
+    lib_after = jnp.where(is_black, packed[:, 2], packed[:, 3])
+    kills = jnp.where(is_black, packed[:, 4], packed[:, 5])
+    ladder = jnp.where(is_black, packed[:, 7], packed[:, 8])
+
+    empty = stones == 0
+    planes = [empty, stones == p3, stones == (3 - p3)]
+    planes += [libs == i for i in (1, 2, 3)] + [libs >= 4]
+    planes += [empty & (lib_after == 0)]
+    planes += [lib_after == i for i in range(1, 6)] + [lib_after >= 6]
+    planes += [kills == i for i in range(1, 7)] + [kills >= 7]
+    planes += [age == i for i in range(1, 6)]
+    planes += [ladder >= 1]
+    planes += [jnp.zeros_like(empty)]  # reference's dead RANK base plane
+    r3 = rank[:, None, None]
+    planes += [jnp.broadcast_to(r3 == i, empty.shape) for i in range(1, 10)]
+    out = jnp.stack(planes, axis=-1).astype(dtype)
+    assert out.shape[-1] == NUM_PLANES
+    return out

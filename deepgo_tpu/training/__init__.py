@@ -1,0 +1,4 @@
+"""Training: optimizers, jitted train/eval steps, the training loop."""
+
+from .optimizers import adagrad, sgd  # noqa: F401
+from .steps import make_eval_step, make_train_step  # noqa: F401

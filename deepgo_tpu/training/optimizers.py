@@ -1,0 +1,74 @@
+"""Minimal functional optimizers (optax-style init/update pairs).
+
+The reference trains with plain SGD whose learning rate decays
+multiplicatively every step (reference optimizer.lua:16-27), and ships a
+(broken) Adagrad (optimizer.lua:1-14 — it reads a global; fixed here).
+Both are provided, plus SGD-with-momentum. State is a pytree, so the whole
+optimizer step jits and shards with the params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any], tuple[Any, Any]]  # (params, grads, state)
+
+
+def sgd(rate: float, rate_decay: float = 0.0, momentum: float = 0.0) -> Optimizer:
+    """params -= rate * grads; rate *= (1 - rate_decay) each step
+    (reference SGD:step, optimizer.lua:24-27). Optional classical momentum."""
+
+    def init(params):
+        state = {"rate": jnp.asarray(rate, jnp.float32)}
+        if momentum:
+            state["velocity"] = jax.tree.map(jnp.zeros_like, params)
+        return state
+
+    def update(params, grads, state):
+        r = state["rate"]
+        if momentum:
+            velocity = jax.tree.map(
+                lambda v, g: momentum * v + g, state["velocity"], grads
+            )
+            params = jax.tree.map(lambda p, v: p - r * v, params, velocity)
+            new_state = {"rate": r * (1.0 - rate_decay), "velocity": velocity}
+        else:
+            params = jax.tree.map(lambda p, g: p - r * g, params, grads)
+            new_state = {"rate": r * (1.0 - rate_decay)}
+        return params, new_state
+
+    return Optimizer(init, update)
+
+
+def adagrad(rate: float, decay: float = 0.95, eps: float = 1e-10) -> Optimizer:
+    """RMS-accumulator Adagrad, the working version of optimizer.lua:1-14:
+    accum = decay*accum + (1-decay)*g^2; params -= rate * g / sqrt(accum)."""
+
+    def init(params):
+        return {
+            "rate": jnp.asarray(rate, jnp.float32),
+            "accum": jax.tree.map(jnp.ones_like, params),
+        }
+
+    def update(params, grads, state):
+        accum = jax.tree.map(
+            lambda a, g: decay * a + (1.0 - decay) * g * g, state["accum"], grads
+        )
+        params = jax.tree.map(
+            lambda p, g, a: p - state["rate"] * g / jnp.sqrt(a + eps),
+            params,
+            grads,
+            accum,
+        )
+        return params, {"rate": state["rate"], "accum": accum}
+
+    return Optimizer(init, update)
+
+
+OPTIMIZERS = {"sgd": sgd, "adagrad": adagrad}

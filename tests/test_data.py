@@ -1,0 +1,95 @@
+"""Dataset format, transcription, and sampling tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+from deepgo_tpu.data import GoDataset
+from deepgo_tpu.data.dataset import M_GAME, DatasetWriter
+from deepgo_tpu.data.transcribe import transcribe_game, transcribe_split
+
+
+@pytest.fixture(scope="module")
+def fixture_dataset(tmp_path_factory):
+    """Transcribe the two small fixture splits into a temp root."""
+    root = tmp_path_factory.mktemp("processed")
+    for split in ("validation", "test"):
+        n = transcribe_split(
+            os.path.join(REPO_ROOT, "data/sgf", split),
+            str(root / split),
+            workers=1,
+            verbose=False,
+        )
+        assert n > 0
+    return str(root)
+
+
+def test_transcribe_counts_match_reference(fixture_dataset):
+    # 134 validation / 125 test examples in the reference's bundled data
+    assert len(GoDataset(fixture_dataset, "validation")) == 134
+    assert len(GoDataset(fixture_dataset, "test")) == 125
+
+
+def test_transcribe_idempotent(fixture_dataset):
+    n = transcribe_split(
+        os.path.join(REPO_ROOT, "data/sgf/test"),
+        os.path.join(fixture_dataset, "test"),
+        verbose=False,
+    )
+    assert n == 125  # second call reuses the existing shard
+
+
+def test_batch_contents(fixture_dataset):
+    ds = GoDataset(fixture_dataset, "test")
+    packed, player, rank, target = ds.first_n(8)
+    assert packed.shape == (8, 9, 19, 19) and packed.dtype == np.uint8
+    assert set(np.unique(player)) <= {1, 2}
+    assert ((rank >= 1) & (rank <= 9)).all()
+    assert ((target >= 0) & (target < 361)).all()
+    # first move of the game: empty board, black to move
+    assert packed[0, 0].sum() == 0 and player[0] == 1
+
+
+def test_game_sampling_in_range(fixture_dataset):
+    ds = GoDataset(fixture_dataset, "validation")
+    rng = np.random.default_rng(7)
+    idx = ds.sample_indices(rng, 1000, scheme="game")
+    assert ((idx >= 0) & (idx < len(ds))).all()
+    idx = ds.sample_indices(rng, 1000, scheme="uniform")
+    assert ((idx >= 0) & (idx < len(ds))).all()
+
+
+def test_game_scheme_uniform_over_games():
+    """The 'game' scheme must weight games equally regardless of length
+    (reference Dataset:generate_random_filename, data.lua:29-37)."""
+    writer_dir = None
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        writer = DatasetWriter(d)
+        # game A: 10 positions, game B: 90 positions
+        for name, m in (("a", 10), ("b", 90)):
+            packed = np.zeros((m, 9, 19, 19), np.uint8)
+            meta = np.zeros((m, 6), np.int32)
+            meta[:, 0] = 1
+            meta[:, 3:5] = 5
+            writer.add_game(name, packed, meta)
+        writer.finalize()
+        ds = GoDataset(os.path.dirname(d), os.path.basename(d))
+        rng = np.random.default_rng(0)
+        idx = ds.sample_indices(rng, 4000, scheme="game")
+        frac_a = (idx < 10).mean()
+        assert 0.45 < frac_a < 0.55  # ~half from the short game
+        assert ds.meta[idx][:, M_GAME].max() == 1
+
+
+def test_transcribe_game_skips_unranked(tmp_path):
+    p = tmp_path / "g.sgf"
+    p.write_text("(;BR[5k]WR[1d];B[pd];W[dd])")
+    assert transcribe_game(str(p)) is None
+    p.write_text("(;BR[3d]WR[1d];B[pd];W[dd])")
+    packed, meta = transcribe_game(str(p))
+    assert packed.shape == (2, 9, 19, 19)
+    assert meta[0].tolist() == [1, 15, 3, 3, 1, 0]

@@ -1,0 +1,119 @@
+"""Model, on-device expansion, and train-step tests (CPU backend)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepgo_tpu import features
+from deepgo_tpu.go import new_board, play, summarize
+from deepgo_tpu.models import ModelConfig, apply, init, num_params
+from deepgo_tpu.models.policy_cnn import log_policy
+from deepgo_tpu.ops import expand_planes
+from deepgo_tpu.training import make_eval_step, make_train_step, sgd, adagrad
+
+
+def _packed_batch(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    out, players, ranks = [], [], []
+    stones, age = new_board()
+    for i in range(n * 10):
+        x, y = rng.integers(0, 19, size=2)
+        if stones[x, y] == 0:
+            play(stones, age, int(x), int(y), int(i % 2 + 1))
+        if i % 10 == 9:
+            out.append(summarize(stones, age))
+            players.append(i % 2 + 1)
+            ranks.append(int(rng.integers(1, 10)))
+    return (
+        np.stack(out),
+        np.array(players, dtype=np.int32),
+        np.array(ranks, dtype=np.int32),
+    )
+
+
+def test_expand_matches_numpy_reference():
+    packed, player, rank = _packed_batch()
+    got = np.asarray(expand_planes(jnp.asarray(packed), jnp.asarray(player),
+                                   jnp.asarray(rank), dtype=jnp.float32))
+    for i in range(packed.shape[0]):
+        want = features.expand_planes_np(packed[i], int(player[i]), int(rank[i]))
+        # ours is NHWC; the reference layout is CHW
+        assert np.array_equal(got[i].transpose(2, 0, 1), want), f"sample {i}"
+
+
+def test_model_shapes_and_param_count():
+    cfg = ModelConfig(num_layers=3, channels=64)
+    params = init(jax.random.key(0), cfg)
+    assert len(params["layers"]) == 3
+    # 5x5x37x64 + 3x3x64x64 + 3x3x64x1 weights, plus (19,19,C) biases
+    expected = (5 * 5 * 37 * 64 + 361 * 64) + (3 * 3 * 64 * 64 + 361 * 64) + (
+        3 * 3 * 64 * 1 + 361
+    )
+    assert num_params(params) == expected
+
+    planes = jnp.zeros((2, 19, 19, 37), jnp.float32)
+    logits = apply(params, planes, cfg)
+    assert logits.shape == (2, 361) and logits.dtype == jnp.float32
+
+
+def test_log_policy_normalized():
+    cfg = ModelConfig(num_layers=3, channels=16)
+    params = init(jax.random.key(1), cfg)
+    packed, player, rank = _packed_batch()
+    planes = expand_planes(jnp.asarray(packed), jnp.asarray(player), jnp.asarray(rank))
+    logp = log_policy(params, planes, cfg)
+    np.testing.assert_allclose(np.exp(np.asarray(logp)).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_final_relu_parity_mode():
+    cfg = ModelConfig(num_layers=3, channels=16, final_relu=True)
+    params = init(jax.random.key(2), cfg)
+    packed, player, rank = _packed_batch()
+    planes = expand_planes(jnp.asarray(packed), jnp.asarray(player), jnp.asarray(rank))
+    logits = apply(params, planes, cfg)
+    assert (np.asarray(logits) >= 0).all()  # the reference's clamped head
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adagrad"])
+def test_train_step_decreases_loss_and_rate_decay(opt_name):
+    cfg = ModelConfig(num_layers=3, channels=16)
+    params = init(jax.random.key(0), cfg)
+    opt = sgd(0.05, rate_decay=1e-3) if opt_name == "sgd" else adagrad(0.05)
+    opt_state = opt.init(params)
+    step = make_train_step(cfg, opt)
+
+    packed, player, rank = _packed_batch(n=4)
+    batch = {
+        "packed": jnp.asarray(packed),
+        "player": jnp.asarray(player),
+        "rank": jnp.asarray(rank),
+        "target": jnp.asarray(np.array([3, 77, 240, 360], dtype=np.int32)),
+    }
+    losses = []
+    for _ in range(25):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[0] > losses[-1], losses
+    if opt_name == "sgd":
+        # multiplicative rate decay, reference optimizer.lua:26
+        np.testing.assert_allclose(
+            float(opt_state["rate"]), 0.05 * (1 - 1e-3) ** 25, rtol=1e-5
+        )
+
+
+def test_eval_step_counts():
+    cfg = ModelConfig(num_layers=2, channels=8)
+    params = init(jax.random.key(0), cfg)
+    evaluate = make_eval_step(cfg)
+    packed, player, rank = _packed_batch(n=4)
+    batch = {
+        "packed": jnp.asarray(packed),
+        "player": jnp.asarray(player),
+        "rank": jnp.asarray(rank),
+        "target": jnp.asarray(np.zeros(4, dtype=np.int32)),
+    }
+    sum_nll, correct = evaluate(params, batch)
+    assert sum_nll.shape == () and 0 <= int(correct) <= 4
+    assert float(sum_nll) > 0
