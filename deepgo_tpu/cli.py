@@ -1,0 +1,103 @@
+"""Command-line entry points.
+
+  python -m deepgo_tpu.cli train       train (or resume) an experiment
+  python -m deepgo_tpu.cli eval        evaluate a checkpoint on a split
+  python -m deepgo_tpu.cli localtest   20-iteration CPU-size smoke run on the
+                                       bundled fixture (reference localtest.lua)
+
+Config overrides are ``--set key=value`` pairs against ExperimentConfig
+(the reference's prototype-override tables, experiments.lua:19-31, and its
+torch.CmdLine flags, experiments/repeated.lua:6-10).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from .experiments import Experiment, ExperimentConfig
+
+
+def parse_overrides(pairs: list[str]) -> dict:
+    fields = {f.name: f for f in dataclasses.fields(ExperimentConfig)}
+    out = {}
+    for pair in pairs:
+        key, _, raw = pair.partition("=")
+        if key not in fields:
+            raise SystemExit(f"unknown config field {key!r}; valid: {sorted(fields)}")
+        ftype = fields[key].type
+        if ftype == "bool":
+            out[key] = raw.lower() in ("1", "true", "yes")
+        elif ftype == "int":
+            out[key] = int(raw)
+        elif ftype == "float":
+            out[key] = float(raw)
+        else:
+            out[key] = raw
+    return out
+
+
+def cmd_train(args) -> None:
+    if args.resume:
+        exp = Experiment.load(args.resume)
+        print(f"resumed {exp.id} at step {exp.step}")
+    else:
+        config = ExperimentConfig(**parse_overrides(args.set))
+        exp = Experiment(config)
+        print(f"experiment {exp.id}")
+    summary = exp.run(args.iters)
+    print(f"final EWMA cost {summary['final_ewma']:.4f}; "
+          f"checkpoint at {exp.save()}")
+
+
+def cmd_eval(args) -> None:
+    exp = Experiment.load(args.checkpoint)
+    result = exp.evaluate(split=args.split, limit=args.limit)
+    print(f"{args.split}: cost={result['cost']:.4f} "
+          f"accuracy={result['accuracy']:.4f} n={result['n']}")
+
+
+def cmd_localtest(args) -> None:
+    """End-to-end smoke on the bundled data (reference localtest.lua:1-11)."""
+    config = ExperimentConfig(
+        name="localtest",
+        batch_size=16,
+        channels=32,
+        validation_size=64,
+        validation_interval=20,
+        loader_threads=1,
+        data_parallel=1,
+        **parse_overrides(args.set),
+    )
+    exp = Experiment(config)
+    summary = exp.run(args.iters)
+    print(f"localtest done: final EWMA {summary['final_ewma']:.4f}")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="deepgo_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("train", help="train or resume an experiment")
+    p.add_argument("--iters", type=int, required=True)
+    p.add_argument("--resume", help="checkpoint path to continue from")
+    p.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("eval", help="evaluate a checkpoint")
+    p.add_argument("--checkpoint", required=True)
+    p.add_argument("--split", default="test")
+    p.add_argument("--limit", type=int)
+    p.set_defaults(fn=cmd_eval)
+
+    p = sub.add_parser("localtest", help="bundled-data smoke run")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
+    p.set_defaults(fn=cmd_localtest)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

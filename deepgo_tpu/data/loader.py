@@ -1,0 +1,106 @@
+"""Asynchronous input pipeline: host sampling threads feeding the device.
+
+The reference dedicates 32 Lua threads to loading+preprocessing because its
+per-sample 37-plane expansion is host-side and slow (data.lua:11-24,
+dataloader.lua:113-125). Here the host only gathers packed uint8 records
+from a memmap (~3.2 KB/position), so a couple of sampler threads saturate
+the pipeline; expansion happens on device inside the jitted step.
+
+Batches are handed to JAX with ``jax.device_put`` as soon as they are
+pulled, so the transfer of batch N+1 overlaps with the computation of
+batch N (double buffering) — replacing the reference's synchronous
+per-iteration CudaTensor copies (train.lua:99-103).
+
+``num_threads=0`` degenerates to fully synchronous in-caller sampling, the
+deterministic debugging mode the reference gets from
+``prepare_data_loaders(1)`` (data.lua:20-24).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import jax
+import numpy as np
+
+from .dataset import GoDataset
+
+
+def make_host_batch(dataset: GoDataset, rng: np.random.Generator, batch_size: int,
+                    scheme: str = "game") -> dict:
+    packed, player, rank, target = dataset.sample_batch(rng, batch_size, scheme)
+    return {"packed": packed, "player": player, "rank": rank, "target": target}
+
+
+class AsyncLoader:
+    """Bounded-queue prefetching sampler over a GoDataset split."""
+
+    def __init__(
+        self,
+        dataset: GoDataset,
+        batch_size: int,
+        scheme: str = "game",
+        seed: int = 0,
+        num_threads: int = 2,
+        prefetch: int = 4,
+        sharding=None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.scheme = scheme
+        self.sharding = sharding
+        self.num_threads = num_threads
+        self._seq = np.random.SeedSequence(seed)
+        if num_threads > 0:
+            self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+            self._stop = threading.Event()
+            self._threads = [
+                threading.Thread(
+                    target=self._worker,
+                    args=(np.random.default_rng(s),),
+                    daemon=True,
+                )
+                for s in self._seq.spawn(num_threads)
+            ]
+            for t in self._threads:
+                t.start()
+        else:
+            self._rng = np.random.default_rng(self._seq)
+
+    def _worker(self, rng: np.random.Generator) -> None:
+        while not self._stop.is_set():
+            batch = make_host_batch(self.dataset, rng, self.batch_size, self.scheme)
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def get(self) -> dict:
+        """Next batch, already dispatched to device (async transfer)."""
+        if self.num_threads > 0:
+            batch = self._queue.get()
+        else:
+            batch = make_host_batch(self.dataset, self._rng, self.batch_size,
+                                    self.scheme)
+        if self.sharding is not None:
+            return jax.device_put(batch, self.sharding)
+        return jax.device_put(batch)
+
+    def __iter__(self):
+        while True:
+            yield self.get()
+
+    def close(self) -> None:
+        if self.num_threads > 0:
+            self._stop.set()
+            for t in self._threads:
+                t.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

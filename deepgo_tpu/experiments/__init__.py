@@ -1,0 +1,3 @@
+"""Experiment management: config, runs, checkpoints, sweeps, plotting."""
+
+from .experiment import Experiment, ExperimentConfig  # noqa: F401

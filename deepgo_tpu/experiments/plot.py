@@ -1,0 +1,69 @@
+"""Validation-curve plotting from run metrics.
+
+The reference plots validation costs out of checkpoint files inside iTorch
+(plot.lua:5-29). Runs here stream JSONL metrics, so plotting reads those:
+emits a CSV (always) and a PNG when matplotlib is importable.
+
+Usage:
+  python -m deepgo_tpu.experiments.plot runs/<id> [runs/<id2> ...] [--out curves]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ..utils.metrics import read_jsonl
+
+
+def load_curves(run_dirs: list[str]) -> dict[str, list[tuple[int, float, float]]]:
+    curves = {}
+    for run_dir in run_dirs:
+        path = os.path.join(run_dir, "metrics.jsonl")
+        rows = [r for r in read_jsonl(path) if r["kind"] == "validation"]
+        curves[os.path.basename(run_dir.rstrip("/"))] = [
+            (r["step"], r["cost"], r["accuracy"]) for r in rows
+        ]
+    return curves
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("runs", nargs="+")
+    ap.add_argument("--out", default="curves")
+    args = ap.parse_args(argv)
+
+    curves = load_curves(args.runs)
+    csv_path = args.out + ".csv"
+    with open(csv_path, "w") as f:
+        f.write("run,step,validation_cost,validation_accuracy\n")
+        for run, rows in curves.items():
+            for step, cost, acc in rows:
+                f.write(f"{run},{step},{cost},{acc}\n")
+    print(f"wrote {csv_path}")
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib unavailable; CSV only")
+        return
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4))
+    for run, rows in curves.items():
+        if not rows:
+            continue
+        steps, costs, accs = zip(*rows)
+        ax1.plot(steps, costs, label=run)
+        ax2.plot(steps, accs, label=run)
+    ax1.set_xlabel("step"); ax1.set_ylabel("validation NLL"); ax1.legend()
+    ax2.set_xlabel("step"); ax2.set_ylabel("top-1 accuracy"); ax2.legend()
+    fig.tight_layout()
+    png_path = args.out + ".png"
+    fig.savefig(png_path, dpi=120)
+    print(f"wrote {png_path}")
+
+
+if __name__ == "__main__":
+    main()

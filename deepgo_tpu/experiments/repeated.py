@@ -1,0 +1,60 @@
+"""Warm-restart sweep: continue a trained checkpoint under a fresh run id
+with a fresh optimizer.
+
+Parity with the reference's sweep entry (experiments/repeated.lua:6-22):
+load a checkpoint, keep weights/step/validation history, re-identify the
+run, reset the optimizer to a fresh state at the configured base rate, and
+train on. ``--num`` replicates the reference's ``-num`` seed-variant flag by
+offsetting the sampling seed.
+
+Usage:
+  python -m deepgo_tpu.experiments.repeated --checkpoint runs/<id>/checkpoint.npz \
+      --iters 20000 [--num K] [--set rate=0.05 ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import uuid
+
+import jax
+
+from ..cli import parse_overrides
+from ..parallel import replicated_sharding
+from .experiment import Experiment, ExperimentConfig
+from . import checkpoint as ckpt
+
+
+def warm_restart(path: str, overrides: dict, num: int = 0) -> Experiment:
+    meta, p_leaves, o_leaves = ckpt.load_checkpoint(path)
+    config = ExperimentConfig.from_dict(meta["config"])
+    if num:
+        overrides = {**overrides, "seed": config.seed + num}
+    if overrides:
+        config = config.replace(**overrides)
+    exp = Experiment(config, run_id=uuid.uuid4().hex[:8])  # fresh identity
+    exp.step = meta["step"]
+    exp.validation_history = list(meta["validation_history"])
+    exp.init()  # fresh optimizer state: reference repeated.lua:17
+    exp.params = jax.device_put(
+        ckpt.unflatten_like(exp.params, p_leaves), replicated_sharding(exp.mesh)
+    )
+    return exp
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint", required=True)
+    ap.add_argument("--iters", type=int, required=True)
+    ap.add_argument("--num", type=int, default=0, help="sweep variant number")
+    ap.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
+    args = ap.parse_args(argv)
+
+    exp = warm_restart(args.checkpoint, parse_overrides(args.set), args.num)
+    print(f"warm restart {exp.id} from {args.checkpoint} at step {exp.step}")
+    exp.run(args.iters)
+    print(f"saved {exp.save()}")
+
+
+if __name__ == "__main__":
+    main()

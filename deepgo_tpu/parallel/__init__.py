@@ -1,0 +1,3 @@
+"""Device meshes, shardings, and distributed helpers."""
+
+from .mesh import data_sharding, make_mesh, replicated_sharding  # noqa: F401

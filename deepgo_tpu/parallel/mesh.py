@@ -1,0 +1,45 @@
+"""Device mesh construction and canonical shardings.
+
+The reference's only parallelism is single-host multi-GPU data parallelism
+through nn.DataParallelTable (reference experiments.lua:155-168): batch split
+on dim 1, gradients reduced across replicas. The TPU-native equivalent is a
+("data", "model") mesh with batches sharded on "data" and parameters
+replicated; under jit, XLA inserts the gradient all-reduce over ICI
+automatically from the sharding constraints — there is no hand-written
+collective in the data-parallel path.
+
+The "model" axis is kept open for tensor parallelism (channel-sharded convs,
+deepgo_tpu.parallel.tensor) even though the reference has none (SURVEY.md
+section 2.3): on a mesh of shape (D, M) every conv weight is sharded on its
+output-channel dimension over M.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_data: int | None = None, n_model: int = 1,
+              devices=None) -> Mesh:
+    """A ("data", "model") mesh. Defaults to all local devices on the data
+    axis; n_data=1, n_model=1 gives the degenerate single-device mesh."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // n_model
+    used = n_data * n_model
+    assert used <= len(devices), (
+        f"mesh {n_data}x{n_model} needs {used} devices, have {len(devices)}"
+    )
+    grid = np.array(devices[:used]).reshape(n_data, n_model)
+    return Mesh(grid, axis_names=("data", "model"))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-axis sharding: dim 0 split over "data", rest replicated."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -56,7 +56,8 @@ def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer):
 def make_eval_step(cfg: policy_cnn.ModelConfig):
     """Returns eval(params, batch) -> (sum_nll, num_correct) over the batch
     (the building block of validation; reference eval_validation,
-    train.lua:14-45)."""
+    train.lua:14-45). An optional float "mask" entry (1 = real example)
+    supports padding partial batches to a fixed shape."""
 
     @jax.jit
     def step(params, batch):
@@ -64,10 +65,13 @@ def make_eval_step(cfg: policy_cnn.ModelConfig):
             batch["packed"], batch["player"], batch["rank"],
             dtype=jnp.dtype(cfg.compute_dtype),
         )
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(batch["target"].shape, jnp.float32)
         logits = policy_cnn.apply(params, planes, cfg)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(logp, batch["target"][:, None], axis=-1)[:, 0]
-        correct = (jnp.argmax(logits, axis=-1) == batch["target"]).sum()
-        return -picked.sum(), correct
+        correct = ((jnp.argmax(logits, axis=-1) == batch["target"]) * mask).sum()
+        return -(picked * mask).sum(), correct
 
     return step

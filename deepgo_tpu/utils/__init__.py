@@ -1,0 +1,4 @@
+"""Utilities: metrics, timing, run identity."""
+
+from .metrics import MetricsWriter, append_registry  # noqa: F401
+from .gitinfo import git_sha  # noqa: F401

@@ -1,0 +1,26 @@
+"""Run provenance: git commit stamping.
+
+The reference's dead run.lua path printed the last git commits at train
+start (run.lua:33-36, the one idea SURVEY.md says is worth keeping);
+here the sha goes into run metadata and checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+
+def git_sha(cwd: str | None = None) -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=5,
+            cwd=cwd or os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+        )
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except Exception:
+        return None

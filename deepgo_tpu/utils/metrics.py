@@ -1,0 +1,50 @@
+"""Metrics and run-registry logging.
+
+Replaces the reference's three observability channels (SURVEY.md section 5.5)
+with local, greppable files:
+  * console prints            -> kept (the train loop prints)
+  * Google-Forms curl POST    -> append to a JSONL run registry
+    (reference logging.lua:3-25 posted hyperparams + results to a form)
+  * checkpoint-based plotting -> per-run metrics JSONL consumed by
+    deepgo_tpu.experiments.plot
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class MetricsWriter:
+    """Append-only JSONL metrics stream for one run."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._f = open(path, "a", buffering=1)
+
+    def write(self, kind: str, **fields) -> None:
+        record = {"kind": kind, "time": time.time(), **fields}
+        self._f.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def append_registry(registry_path: str, record: dict) -> None:
+    """One line per completed run: the reference's results table
+    (logging.lua) without the network dependency."""
+    os.makedirs(os.path.dirname(registry_path) or ".", exist_ok=True)
+    with open(registry_path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def read_jsonl(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

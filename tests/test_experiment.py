@@ -1,0 +1,124 @@
+"""Experiment layer tests: train loop, checkpoint resume, warm restart."""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+from deepgo_tpu.data import GoDataset
+from deepgo_tpu.data.loader import AsyncLoader
+from deepgo_tpu.data.transcribe import transcribe_split
+from deepgo_tpu.experiments import Experiment, ExperimentConfig
+from deepgo_tpu.experiments.repeated import warm_restart
+from deepgo_tpu.utils.metrics import read_jsonl
+
+
+@pytest.fixture(scope="module")
+def data_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("processed")
+    for split in ("validation", "test"):
+        transcribe_split(
+            os.path.join(REPO_ROOT, "data/sgf", split),
+            str(root / split),
+            workers=1,
+            verbose=False,
+        )
+    return str(root)
+
+
+def tiny_config(data_root, **kw):
+    defaults = dict(
+        name="test",
+        num_layers=2,
+        channels=8,
+        batch_size=8,
+        rate=0.05,
+        validation_size=32,
+        validation_interval=10,
+        print_interval=10,
+        data_root=data_root,
+        train_split="validation",  # small split as train data
+        validation_split="test",
+        test_split="test",
+        loader_threads=0,
+        data_parallel=1,
+    )
+    defaults.update(kw)
+    return ExperimentConfig(**defaults)
+
+
+def test_async_loader_matches_sync_sampling(data_root):
+    ds = GoDataset(data_root, "validation")
+    with AsyncLoader(ds, 8, seed=3, num_threads=2, prefetch=2) as loader:
+        batches = [loader.get() for _ in range(5)]
+    for b in batches:
+        assert b["packed"].shape == (8, 9, 19, 19)
+        assert ((np.asarray(b["target"]) >= 0) & (np.asarray(b["target"]) < 361)).all()
+
+
+def test_train_smoke_loss_decreases(data_root, tmp_path):
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
+    exp = Experiment(cfg)
+    summary = exp.run(30)
+    assert exp.step == 30
+    assert summary["final_ewma"] < 5.89  # below uniform-random NLL ln(361)
+    assert summary["last_validation"]["n"] == 32
+    # metrics + registry written
+    metrics = read_jsonl(os.path.join(exp.run_path, "metrics.jsonl"))
+    kinds = {m["kind"] for m in metrics}
+    assert {"train", "validation", "summary"} <= kinds
+    registry = read_jsonl(os.path.join(cfg.run_dir, "registry.jsonl"))
+    assert registry[-1]["id"] == exp.id
+    assert registry[-1]["config"]["channels"] == 8
+
+
+def test_checkpoint_resume_roundtrip(data_root, tmp_path):
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
+    exp = Experiment(cfg)
+    exp.run(12)
+    path = exp.save()
+    before = exp.validate()
+
+    resumed = Experiment.load(path)
+    assert resumed.step == exp.step
+    assert resumed.id == exp.id
+    assert resumed.config == exp.config
+    after = resumed.validate()
+    assert after["cost"] == pytest.approx(before["cost"], rel=1e-5)
+    assert after["accuracy"] == pytest.approx(before["accuracy"])
+    # optimizer state survives: decayed rate rather than the base rate
+    assert float(resumed.opt_state["rate"]) == pytest.approx(
+        float(exp.opt_state["rate"])
+    )
+    resumed.run(5)
+    assert resumed.step == exp.step + 5
+
+
+def test_warm_restart_fresh_optimizer_new_id(data_root, tmp_path):
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"), rate_decay=1e-3)
+    exp = Experiment(cfg)
+    exp.run(15)
+    path = exp.save()
+    decayed = float(exp.opt_state["rate"])
+    assert decayed < cfg.rate
+
+    restarted = warm_restart(path, overrides={}, num=2)
+    assert restarted.id != exp.id
+    assert restarted.step == exp.step  # keeps iteration count
+    assert float(restarted.opt_state["rate"]) == pytest.approx(cfg.rate)  # fresh
+    assert restarted.config.seed == cfg.seed + 2
+    # weights were restored: same validation result as the source
+    a = exp.validate()
+    b = restarted.validate()
+    assert b["cost"] == pytest.approx(a["cost"], rel=1e-5)
+
+
+def test_evaluate_full_split(data_root, tmp_path):
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
+    exp = Experiment(cfg)
+    exp.init()
+    result = exp.evaluate(split="test")
+    assert result["n"] == 125
+    assert result["cost"] > 0
+    assert exp.validation_history == []
