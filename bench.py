@@ -1,0 +1,89 @@
+"""Headline benchmark: batched policy-inference throughput on one chip.
+
+Measures boards/sec through the flagship 12-layer / 128-filter policy
+network (BASELINE.md config 5: "batched self-play policy inference"),
+including the on-device expansion of packed records to the 37 input planes.
+The baseline target is 10,000 boards/sec/chip (BASELINE.json north star).
+
+Methodology: K stacked batches are pushed through a jitted lax.scan whose
+carry accumulates a scalar from every forward pass, so the device must
+execute all K forwards and only one scalar crosses back to the host. (Timing
+individual dispatches is meaningless through the axon relay: completion
+notifications don't gate on remote execution, and per-call host fetches
+measure tunnel round-trips, not compute.)
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "boards/sec", "vs_baseline": N/10000}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_BOARDS_PER_SEC = 10_000.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.ops import expand_planes
+
+    device = jax.devices()[0]
+    on_tpu = device.platform != "cpu"
+    # CPU fallback keeps the benchmark runnable anywhere; the headline
+    # number is the TPU one.
+    batch, k_batches, repeats = (8192, 8, 3) if on_tpu else (256, 2, 1)
+
+    cfg = policy_cnn.CONFIGS["full"]
+    params = policy_cnn.init(jax.random.key(0), cfg)
+
+    def run_many(params, packed, player, rank):
+        def body(acc, b):
+            planes = expand_planes(b[0], b[1], b[2],
+                                   dtype=jnp.dtype(cfg.compute_dtype))
+            logits = policy_cnn.apply(params, planes, cfg)
+            return acc + logits.sum(), None
+
+        acc, _ = jax.lax.scan(body, jnp.float32(0), (packed, player, rank))
+        return acc
+
+    fn = jax.jit(run_many)
+    rng = np.random.default_rng(0)
+    data = jax.device_put(
+        (
+            rng.integers(0, 3, size=(k_batches, batch, 9, 19, 19), dtype=np.uint8),
+            rng.integers(1, 3, size=(k_batches, batch)).astype(np.int32),
+            rng.integers(1, 10, size=(k_batches, batch)).astype(np.int32),
+        )
+    )
+
+    value = float(fn(params, *data))  # compile + warm; also a sanity value
+    assert np.isfinite(value), "non-finite benchmark output"
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.time()
+        float(fn(params, *data))  # scalar fetch forces completion
+        times.append(time.time() - t0)
+    dt = float(np.median(times))
+    boards_per_sec = k_batches * batch / dt
+
+    print(json.dumps({
+        "metric": "policy_inference_boards_per_sec_per_chip",
+        "value": round(boards_per_sec, 1),
+        "unit": "boards/sec",
+        "vs_baseline": round(boards_per_sec / BASELINE_BOARDS_PER_SEC, 3),
+        "model": "12-layer/128-filter policy CNN (bf16)",
+        "batch": batch,
+        "device": str(device),
+        "ms_per_batch": round(1000 * dt / k_batches, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

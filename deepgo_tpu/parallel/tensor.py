@@ -1,0 +1,42 @@
+"""Tensor parallelism: channel-sharded convolution parameters.
+
+The reference has no tensor parallelism (SURVEY.md section 2.3) — this is the
+"model axis kept open" design: conv weights shard their output-channel
+dimension over the mesh's "model" axis, biases likewise; the final 1-channel
+head stays replicated. Under jit, XLA's SPMD partitioner propagates these
+parameter shardings through the conv stack and inserts the collectives over
+ICI; there are no hand-written all-gathers.
+
+With ("data", "model") = (D, M), each device holds 1/M of every hidden
+conv's filters and sees 1/D of the batch.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_shardings(params: dict, mesh: Mesh):
+    """A pytree of NamedShardings matching a policy_cnn params pytree."""
+    n_layers = len(params["layers"])
+
+    def layer_sharding(i: int, name: str, leaf):
+        c_out = leaf.shape[-1]
+        if c_out % mesh.shape["model"] != 0:  # e.g. the 1-channel head
+            return NamedSharding(mesh, P())
+        if name == "w":
+            return NamedSharding(mesh, P(None, None, None, "model"))
+        return NamedSharding(mesh, P(None, None, "model"))  # (19, 19, C) bias
+
+    return {
+        "layers": [
+            {name: layer_sharding(i, name, leaf) for name, leaf in layer.items()}
+            for i, layer in enumerate(params["layers"])
+        ]
+    }
+
+
+def shard_params(params: dict, mesh: Mesh):
+    """Place params according to ``param_shardings``."""
+    return jax.device_put(params, param_shardings(params, mesh))
