@@ -1,0 +1,113 @@
+"""ctypes bridge to the native C++ rules engine (native/goboard.cpp).
+
+The shared library is built on first use (``make -C native``) and cached;
+every consumer falls back to the pure-Python engine when a compiler is
+unavailable, so the native path is an accelerator, never a requirement.
+Python and C++ engines are semantically identical (cross-tested, plus the
+same golden parity suite against the reference's records).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from ..features import PACKED_CHANNELS
+from .. import BOARD_SIZE
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libgoboard.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        result = subprocess.run(
+            ["make", "-C", _NATIVE_DIR], capture_output=True, text=True, timeout=120
+        )
+        return result.returncode == 0 and os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.goboard_transcribe.restype = ctypes.c_int
+        lib.goboard_transcribe.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.goboard_summarize.restype = None
+        lib.goboard_summarize.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _moves_array(moves) -> np.ndarray:
+    return np.array([(m.player, m.x, m.y) for m in moves], dtype=np.int32).reshape(-1, 3)
+
+
+def transcribe_game_native(handicaps, moves) -> np.ndarray:
+    """Replay a whole game natively -> packed (M, 9, 19, 19) records of the
+    pre-move boards. Raises on illegal positions (like the Python engine)."""
+    lib = load()
+    assert lib is not None, "native engine unavailable"
+    h = _moves_array(handicaps)
+    m = _moves_array(moves)
+    out = np.empty(
+        (len(moves), PACKED_CHANNELS, BOARD_SIZE, BOARD_SIZE), dtype=np.uint8
+    )
+    rc = lib.goboard_transcribe(
+        h.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(handicaps),
+        m.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(moves),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if rc != 0:
+        from .board import IllegalMoveError
+
+        if rc <= -1000000:
+            raise IllegalMoveError(f"illegal handicap placement #{-(rc + 1000000) - 1}")
+        raise IllegalMoveError(f"illegal move #{-rc - 1}")
+    return out
+
+
+def summarize_native(stones: np.ndarray, age: np.ndarray) -> np.ndarray:
+    lib = load()
+    assert lib is not None, "native engine unavailable"
+    s = np.ascontiguousarray(stones, dtype=np.uint8)
+    a = np.ascontiguousarray(age, dtype=np.int32)
+    out = np.empty((PACKED_CHANNELS, BOARD_SIZE, BOARD_SIZE), dtype=np.uint8)
+    lib.goboard_summarize(
+        s.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out

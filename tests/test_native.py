@@ -1,0 +1,65 @@
+"""Native C++ engine equivalence tests (skipped when no compiler)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+from deepgo_tpu import sgf
+from deepgo_tpu.go import native, new_board, play, replay_positions, summarize
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native engine not buildable"
+)
+
+
+def test_summarize_matches_python_random_boards():
+    rng = np.random.default_rng(42)
+    stones, age = new_board()
+    for i in range(200):
+        x, y = rng.integers(0, 19, size=2)
+        if stones[x, y] == 0:
+            play(stones, age, int(x), int(y), int(rng.integers(1, 3)))
+        if i % 25 == 24:
+            want = summarize(stones, age)
+            got = native.summarize_native(stones, age)
+            assert np.array_equal(got, want), f"after {i + 1} placements"
+
+
+def test_transcribe_game_matches_python():
+    path = os.path.join(REPO_ROOT, "data/sgf/validation/1950-59/2000-03-24a.sgf")
+    game = sgf.parse_file(path)
+    got = native.transcribe_game_native(game.handicaps, game.moves)
+    want = np.stack([p for p, _ in replay_positions(game)])
+    assert np.array_equal(got, want)
+
+
+def test_transcribe_handicap_game():
+    game = sgf.parse("(;BR[9d]WR[9d]AB[pd][dp]AW[dd];B[qq];W[oc])")
+    got = native.transcribe_game_native(game.handicaps, game.moves)
+    want = np.stack([p for p, _ in replay_positions(game)])
+    assert np.array_equal(got, want)
+    assert got[0, 6].max() == 3  # first handicap stone aged 3
+
+
+def test_illegal_move_raises():
+    from deepgo_tpu.go import IllegalMoveError
+
+    game = sgf.parse("(;BR[1d]WR[1d];B[aa];W[aa])")
+    with pytest.raises(IllegalMoveError):
+        native.transcribe_game_native(game.handicaps, game.moves)
+
+
+def test_transcribe_split_engine_parity(tmp_path):
+    from deepgo_tpu.data.transcribe import transcribe_split
+
+    src = os.path.join(REPO_ROOT, "data/sgf/test")
+    n1 = transcribe_split(src, str(tmp_path / "native"), engine="native",
+                          workers=1, verbose=False)
+    n2 = transcribe_split(src, str(tmp_path / "python"), engine="python",
+                          workers=1, verbose=False)
+    assert n1 == n2 == 125
+    a = np.fromfile(tmp_path / "native" / "planes.bin", dtype=np.uint8)
+    b = np.fromfile(tmp_path / "python" / "planes.bin", dtype=np.uint8)
+    assert np.array_equal(a, b)
