@@ -69,6 +69,7 @@ class ExperimentConfig:
     # parallelism (mesh axes; reference analogue: numGPUs, experiments.lua:10)
     data_parallel: int = 0  # 0 = all available devices
     tensor_parallel: int = 1
+    expand_backend: str = "xla"  # "xla" | "pallas" | "auto"
     # identity
     seed: int = 0
     run_dir: str = "runs"
@@ -127,8 +128,10 @@ class Experiment:
         rep = replicated_sharding(self.mesh)
         self.params = jax.device_put(self.params, rep)
         self.opt_state = jax.device_put(self.opt_state, rep)
-        self.train_step = make_train_step(self.model_cfg, self.optimizer)
-        self.eval_step = make_eval_step(self.model_cfg)
+        self.train_step = make_train_step(self.model_cfg, self.optimizer,
+                                          expand_backend=cfg.expand_backend)
+        self.eval_step = make_eval_step(self.model_cfg,
+                                        expand_backend=cfg.expand_backend)
         self.batch_sharding = data_sharding(self.mesh)
         self.run_path = os.path.join(self.config.run_dir, self.id)
         os.makedirs(self.run_path, exist_ok=True)

@@ -1,3 +1,15 @@
 """Device-side ops: feature expansion, model kernels, Pallas kernels."""
 
 from .expand import expand_planes  # noqa: F401
+
+
+def get_expand_fn(backend: str = "xla"):
+    """Select the plane-expansion backend: "xla" (default), "pallas", or
+    "auto" (pallas when the current backend can compile Mosaic kernels)."""
+    if backend == "xla":
+        return expand_planes
+    from .pallas_expand import expand_planes_pallas, pallas_supported
+
+    if backend == "pallas" or (backend == "auto" and pallas_supported()):
+        return expand_planes_pallas
+    return expand_planes

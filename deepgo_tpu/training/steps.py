@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models import policy_cnn
-from ..ops import expand_planes
+from ..ops import expand_planes, get_expand_fn
 from .optimizers import Optimizer
 
 
@@ -32,8 +32,10 @@ def nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return -picked.mean()
 
 
-def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer):
+def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
+                    expand_backend: str = "xla"):
     """Returns step(params, opt_state, batch) -> (params, opt_state, loss)."""
+    expand_planes = get_expand_fn(expand_backend)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch):
@@ -53,11 +55,12 @@ def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer):
     return step
 
 
-def make_eval_step(cfg: policy_cnn.ModelConfig):
+def make_eval_step(cfg: policy_cnn.ModelConfig, expand_backend: str = "xla"):
     """Returns eval(params, batch) -> (sum_nll, num_correct) over the batch
     (the building block of validation; reference eval_validation,
     train.lua:14-45). An optional float "mask" entry (1 = real example)
     supports padding partial batches to a fixed shape."""
+    expand_planes = get_expand_fn(expand_backend)
 
     @jax.jit
     def step(params, batch):
