@@ -28,9 +28,13 @@ from .dataset import GoDataset
 
 
 def make_host_batch(dataset: GoDataset, rng: np.random.Generator, batch_size: int,
-                    scheme: str = "game") -> dict:
+                    scheme: str = "game", augment: bool = False) -> dict:
     packed, player, rank, target = dataset.sample_batch(rng, batch_size, scheme)
-    return {"packed": packed, "player": player, "rank": rank, "target": target}
+    batch = {"packed": packed, "player": player, "rank": rank, "target": target}
+    if augment:
+        # per-sample dihedral symmetry index, applied on device
+        batch["sym"] = rng.integers(0, 8, size=batch_size).astype(np.int32)
+    return batch
 
 
 class AsyncLoader:
@@ -45,11 +49,13 @@ class AsyncLoader:
         num_threads: int = 2,
         prefetch: int = 4,
         sharding=None,
+        augment: bool = False,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
         self.scheme = scheme
         self.sharding = sharding
+        self.augment = augment
         self.num_threads = num_threads
         self._seq = np.random.SeedSequence(seed)
         if num_threads > 0:
@@ -70,7 +76,8 @@ class AsyncLoader:
 
     def _worker(self, rng: np.random.Generator) -> None:
         while not self._stop.is_set():
-            batch = make_host_batch(self.dataset, rng, self.batch_size, self.scheme)
+            batch = make_host_batch(self.dataset, rng, self.batch_size,
+                                    self.scheme, self.augment)
             while not self._stop.is_set():
                 try:
                     self._queue.put(batch, timeout=0.1)
@@ -84,7 +91,7 @@ class AsyncLoader:
             batch = self._queue.get()
         else:
             batch = make_host_batch(self.dataset, self._rng, self.batch_size,
-                                    self.scheme)
+                                    self.scheme, self.augment)
         if self.sharding is not None:
             return jax.device_put(batch, self.sharding)
         return jax.device_put(batch)

@@ -59,6 +59,7 @@ class ExperimentConfig:
     validation_interval: int = 2000
     print_interval: int = 10
     # data
+    augment: bool = False  # dihedral board symmetries (reference's stub)
     data_root: str = "data/processed"
     train_split: str = "train"
     validation_split: str = "validation"
@@ -70,9 +71,10 @@ class ExperimentConfig:
     data_parallel: int = 0  # 0 = all available devices
     tensor_parallel: int = 1
     expand_backend: str = "xla"  # "xla" | "pallas" | "auto"
-    # identity
+    # identity / observability
     seed: int = 0
     run_dir: str = "runs"
+    profile: bool = False  # capture a jax.profiler trace of train() into the run dir
 
     def model_config(self) -> policy_cnn.ModelConfig:
         return policy_cnn.ModelConfig(
@@ -129,7 +131,8 @@ class Experiment:
         self.params = jax.device_put(self.params, rep)
         self.opt_state = jax.device_put(self.opt_state, rep)
         self.train_step = make_train_step(self.model_cfg, self.optimizer,
-                                          expand_backend=cfg.expand_backend)
+                                          expand_backend=cfg.expand_backend,
+                                          augment=cfg.augment)
         self.eval_step = make_eval_step(self.model_cfg,
                                         expand_backend=cfg.expand_backend)
         self.batch_sharding = data_sharding(self.mesh)
@@ -164,6 +167,13 @@ class Experiment:
         return summary
 
     def train(self, iters: int) -> dict:
+        from ..utils.profiling import trace
+
+        cfg = self.config
+        with trace(os.path.join(self.run_path, "trace") if cfg.profile else None):
+            return self._train(iters)
+
+    def _train(self, iters: int) -> dict:
         cfg = self.config
         train_set = self._dataset(cfg.train_split)
         metrics = MetricsWriter(os.path.join(self.run_path, "metrics.jsonl"))
@@ -182,13 +192,22 @@ class Experiment:
             num_threads=cfg.loader_threads,
             prefetch=cfg.prefetch,
             sharding=self.batch_sharding,
+            augment=cfg.augment,
         ) as loader:
             for _ in range(iters):
                 t0 = time.time()
                 batch = loader.get()
-                self.params, self.opt_state, loss = self.train_step(
-                    self.params, self.opt_state, batch
-                )
+                try:
+                    self.params, self.opt_state, loss = self.train_step(
+                        self.params, self.opt_state, batch
+                    )
+                except Exception:
+                    # postmortem capture: stash the failing batch for offline
+                    # debugging (reference train.lua:106-109 kept it in
+                    # globals; a file survives the process)
+                    bad = {k: np.asarray(v) for k, v in batch.items()}
+                    np.savez(os.path.join(self.run_path, "bad_batch.npz"), **bad)
+                    raise
                 self.step += 1
                 loss = float(loss)  # blocks; keeps EWMA exact
                 ewma = loss if ewma is None else 0.95 * ewma + 0.05 * loss

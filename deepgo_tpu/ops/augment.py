@@ -1,0 +1,66 @@
+"""Dihedral-group data augmentation for board positions.
+
+The reference stubbed this out (``transform``, dataloader.lua:41-44:
+"eventually this should do random rotation and reflection") — here it is
+implemented, on device. Go is symmetric under the 8 board symmetries and
+every packed channel is a spatial map (the rules are rotation/reflection
+equivariant), so augmentation is a pure position permutation applied to both
+the packed record and the move target.
+
+The 8 permutations are precomputed host-side as an (8, 361) gather table:
+``transformed_flat[p] = flat[PERM[k, p]]`` and the target moves with
+``TARGET_MAP[k, target]``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import BOARD_SIZE, NUM_POINTS
+
+
+def _dihedral_tables() -> tuple[np.ndarray, np.ndarray]:
+    """(PERM, TARGET_MAP), each (8, 361) int32.
+
+    Variant k = (r, f) with r quarter-turn rotations (0..3) and f horizontal
+    flip (0..1), applied to the (x, y) grid as numpy rot90/fliplr.
+    """
+    base = np.arange(NUM_POINTS).reshape(BOARD_SIZE, BOARD_SIZE)
+    perms, target_maps = [], []
+    for flip in (False, True):
+        for rot in range(4):
+            grid = np.rot90(base, rot)
+            if flip:
+                grid = np.fliplr(grid)
+            # grid[p_new] = p_old  ==> gather table for plane values
+            perms.append(grid.reshape(-1))
+            # a stone/move at old position p lands at the new index of p
+            inv = np.empty(NUM_POINTS, dtype=np.int64)
+            inv[grid.reshape(-1)] = np.arange(NUM_POINTS)
+            target_maps.append(inv)
+    return (
+        np.stack(perms).astype(np.int32),
+        np.stack(target_maps).astype(np.int32),
+    )
+
+
+_PERM_NP, _TARGET_MAP_NP = _dihedral_tables()
+NUM_SYMMETRIES = 8
+
+
+def augment_batch(packed, target, sym):
+    """Apply per-sample board symmetries on device.
+
+    packed (B, 9, 19, 19) uint8, target (B,) int32, sym (B,) int32 in [0, 8)
+    -> (packed', target') with identical semantics under Go's symmetry group.
+    """
+    b = packed.shape[0]
+    perm = jnp.asarray(_PERM_NP)[sym]  # (B, 361)
+    flat = packed.reshape(b, packed.shape[1], NUM_POINTS)
+    out = jnp.take_along_axis(flat, perm[:, None, :], axis=2)
+    new_target = jnp.take_along_axis(
+        jnp.asarray(_TARGET_MAP_NP)[sym], target[:, None], axis=1
+    )[:, 0]
+    return out.reshape(packed.shape), new_target

@@ -1,0 +1,64 @@
+"""Multi-host (multi-process) distributed setup.
+
+The reference is strictly single-process (SURVEY.md section 2.3: no
+NCCL/MPI/Gloo anywhere; one AWS instance). This module is the TPU-native
+scale-out layer above it: ``jax.distributed`` process bootstrap plus a
+hybrid mesh whose "data" axis spans hosts (gradient reduction rides DCN
+between hosts, ICI within) while "model" stays inside a host's ICI domain —
+the layout the scaling playbook prescribes for data-parallel conv training.
+
+Single-host degenerates cleanly: ``initialize()`` is a no-op and
+``hybrid_mesh`` equals ``make_mesh``. Multi-host batches are assembled with
+``per_host_batch`` -> ``jax.make_array_from_process_local_data`` so each
+host feeds only its own shard (no cross-host host-side traffic).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .mesh import make_mesh
+
+
+def initialize(coordinator: str | None = None, num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join (or skip, when single-process) the JAX distributed runtime.
+
+    On Cloud TPU pods the three arguments are auto-detected from the
+    metadata server; pass them explicitly elsewhere.
+    """
+    if num_processes == 1 or (num_processes is None and coordinator is None
+                              and jax.process_count() == 1):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def hybrid_mesh(n_model: int = 1):
+    """("data", "model") mesh over every device of every process, with the
+    data axis ordered hosts-major so intra-host neighbors stay on ICI."""
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n_data = len(devices) // n_model
+    return make_mesh(n_data, n_model, devices=devices)
+
+
+def per_host_batch(global_batch: int) -> int:
+    """How many samples this process should contribute per step."""
+    assert global_batch % jax.process_count() == 0
+    return global_batch // jax.process_count()
+
+
+def global_array_from_local(mesh, local_batch: dict) -> dict:
+    """Assemble a globally-sharded batch from this host's local samples
+    (each process calls this with its own shard)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("data"))
+    return {
+        k: jax.make_array_from_process_local_data(sharding, np.asarray(v))
+        for k, v in local_batch.items()
+    }

@@ -1,0 +1,72 @@
+"""Explicit-collective data-parallel train step via shard_map + psum.
+
+The default DP path (deepgo_tpu.training.steps + NamedSharding) lets XLA's
+SPMD partitioner derive the gradient all-reduce. This module is the other
+idiomatic formulation — per-device code with an explicit ``lax.psum`` over
+the "data" axis — exactly what nn.DataParallelTable's hidden gradient
+reduction does in the reference (experiments.lua:155-168), but spelled out.
+
+Both paths are tested to produce identical numerics; the explicit one is
+the template to extend when collectives need manual placement (e.g.
+gradient compression, async reduction, or DCN-aware reduction orders on
+multi-host meshes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..models import policy_cnn
+from ..ops import get_expand_fn
+from ..training.optimizers import Optimizer
+from ..training.steps import nll_from_logits
+
+
+def make_shard_map_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
+                              mesh: Mesh, expand_backend: str = "xla"):
+    """step(params, opt_state, batch) with hand-written gradient psum.
+
+    params/opt_state replicated; batch sharded on "data". Each device
+    computes loss+grads on its local shard, then all-reduces by mean.
+    """
+    expand_planes = get_expand_fn(expand_backend)
+    batch_spec = {
+        "packed": P("data"), "player": P("data"), "rank": P("data"),
+        "target": P("data"),
+    }
+
+    def per_device(params, opt_state, batch):
+        planes = expand_planes(
+            batch["packed"], batch["player"], batch["rank"],
+            dtype=jnp.dtype(cfg.compute_dtype),
+        )
+
+        def loss_fn(p):
+            logits = policy_cnn.apply(p, planes, cfg)
+            return nll_from_logits(logits, batch["target"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # THE data-parallel collective: mean-reduce grads over ICI
+        grads = jax.lax.pmean(grads, axis_name="data")
+        loss = jax.lax.pmean(loss, axis_name="data")
+        params, opt_state = optimizer.update(params, grads, opt_state)
+        return params, opt_state, loss
+
+    mapped = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(), batch_spec),
+        out_specs=(P(), P(), P()),
+        check_rep=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        return mapped(params, opt_state, batch)
+
+    return step

@@ -33,20 +33,30 @@ def nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
 
 
 def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
-                    expand_backend: str = "xla"):
-    """Returns step(params, opt_state, batch) -> (params, opt_state, loss)."""
+                    expand_backend: str = "xla", augment: bool = False):
+    """Returns step(params, opt_state, batch) -> (params, opt_state, loss).
+
+    With ``augment=True`` the batch carries a per-sample "sym" entry and the
+    packed record + target are dihedral-transformed on device before
+    expansion (the augmentation the reference stubbed, dataloader.lua:41-44).
+    """
     expand_planes = get_expand_fn(expand_backend)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch):
+        packed, target = batch["packed"], batch["target"]
+        if augment:
+            from ..ops.augment import augment_batch
+
+            packed, target = augment_batch(packed, target, batch["sym"])
         planes = expand_planes(
-            batch["packed"], batch["player"], batch["rank"],
+            packed, batch["player"], batch["rank"],
             dtype=jnp.dtype(cfg.compute_dtype),
         )
 
         def loss_fn(p):
             logits = policy_cnn.apply(p, planes, cfg)
-            return nll_from_logits(logits, batch["target"])
+            return nll_from_logits(logits, target)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         params, opt_state = optimizer.update(params, grads, opt_state)

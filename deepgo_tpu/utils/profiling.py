@@ -1,0 +1,32 @@
+"""Profiler integration.
+
+The reference's tracing story is wall-clock prints (SURVEY.md section 5.1);
+here the same samples/sec metrics stream to JSONL, and this module adds
+real device profiling: a context manager around ``jax.profiler`` writing a
+TensorBoard-loadable trace, plus annotation helpers for named regions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(out_dir: str | None):
+    """Capture a device/host trace into ``out_dir`` (no-op when None)."""
+    if not out_dir:
+        yield
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    jax.profiler.start_trace(out_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+annotate = jax.profiler.TraceAnnotation  # named host regions in the trace
+step_annotation = jax.profiler.StepTraceAnnotation  # per-step markers

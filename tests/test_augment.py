@@ -1,0 +1,83 @@
+"""Dihedral augmentation tests: identity, bijectivity, and — the real
+property — equivariance with the rules engine: summarize(transform(game))
+== transform(summarize(game))."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepgo_tpu import sgf
+from deepgo_tpu.go import new_board, play, summarize
+from deepgo_tpu.ops.augment import _PERM_NP, _TARGET_MAP_NP, augment_batch
+
+
+def test_sym0_is_identity():
+    rng = np.random.default_rng(0)
+    packed = rng.integers(0, 255, size=(4, 9, 19, 19), dtype=np.uint8)
+    target = rng.integers(0, 361, size=4).astype(np.int32)
+    out, new_target = augment_batch(
+        jnp.asarray(packed), jnp.asarray(target), jnp.zeros(4, jnp.int32)
+    )
+    assert np.array_equal(np.asarray(out), packed)
+    assert np.array_equal(np.asarray(new_target), target)
+
+
+def test_tables_are_permutations():
+    for k in range(8):
+        assert sorted(_PERM_NP[k]) == list(range(361))
+        assert sorted(_TARGET_MAP_NP[k]) == list(range(361))
+        # TARGET_MAP is PERM's inverse
+        assert np.array_equal(_PERM_NP[k][_TARGET_MAP_NP[k]], np.arange(361))
+
+
+def _transform_moves(moves, k):
+    """Apply symmetry k to move coordinates via the target map."""
+    out = []
+    for m in moves:
+        t = int(_TARGET_MAP_NP[k][19 * m.x + m.y])
+        out.append(sgf.Move(m.player, t // 19, t % 19))
+    return out
+
+
+@pytest.mark.parametrize("k", range(8))
+def test_equivariance_with_rules_engine(k):
+    """Playing a transformed game must give the transformed summary: the
+    packed features commute with board symmetries."""
+    game = sgf.parse(
+        "(;BR[5d]WR[5d];B[pd];W[dd];B[pq];W[dp];B[qf];W[cf];B[cq];W[dq]"
+        ";B[cp];W[do];B[bn];W[fp])"
+    )
+    stones, age = new_board()
+    for m in game.moves:
+        play(stones, age, m.x, m.y, m.player)
+    packed = summarize(stones, age)
+
+    stones_t, age_t = new_board()
+    for m in _transform_moves(game.moves, k):
+        play(stones_t, age_t, m.x, m.y, m.player)
+    packed_t = summarize(stones_t, age_t)
+
+    got, _ = augment_batch(
+        jnp.asarray(packed[None]),
+        jnp.zeros(1, jnp.int32),
+        jnp.full((1,), k, jnp.int32),
+    )
+    assert np.array_equal(np.asarray(got)[0], packed_t), f"symmetry {k}"
+
+
+def test_augmented_training_runs(tmp_path):
+    from test_experiment import tiny_config  # reuse the tiny setup
+    from deepgo_tpu.data.transcribe import transcribe_split
+    from deepgo_tpu.experiments import Experiment
+    import os
+    from conftest import REPO_ROOT
+
+    root = tmp_path / "processed"
+    for split in ("validation", "test"):
+        transcribe_split(os.path.join(REPO_ROOT, "data/sgf", split),
+                         str(root / split), workers=1, verbose=False)
+    cfg = tiny_config(str(root), run_dir=str(tmp_path / "runs"), augment=True)
+    exp = Experiment(cfg)
+    summary = exp.run(15)
+    assert summary["final_ewma"] < 5.89
