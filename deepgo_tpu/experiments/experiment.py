@@ -183,7 +183,8 @@ class Experiment:
 
         ewma = None
         last_val: dict = {}
-        total_t0 = time.time()
+        pending: list = []  # device-resident losses, fetched per print window
+        window_t0 = total_t0 = time.time()
         with AsyncLoader(
             train_set,
             cfg.batch_size,
@@ -195,7 +196,6 @@ class Experiment:
             augment=cfg.augment,
         ) as loader:
             for _ in range(iters):
-                t0 = time.time()
                 batch = loader.get()
                 try:
                     self.params, self.opt_state, loss = self.train_step(
@@ -209,11 +209,18 @@ class Experiment:
                     np.savez(os.path.join(self.run_path, "bad_batch.npz"), **bad)
                     raise
                 self.step += 1
-                loss = float(loss)  # blocks; keeps EWMA exact
-                ewma = loss if ewma is None else 0.95 * ewma + 0.05 * loss
-                dt = time.time() - t0
+                # losses stay on device between prints so steps dispatch
+                # asynchronously; fetching every step would serialize the
+                # loop on the host<->device round-trip
+                pending.append(loss)
                 if self.step % cfg.print_interval == 0:
-                    sps = cfg.batch_size / dt
+                    for value in map(float, pending):
+                        ewma = value if ewma is None else 0.95 * ewma + 0.05 * value
+                    loss = float(pending[-1])
+                    pending.clear()
+                    window_dt = time.time() - window_t0
+                    window_t0 = time.time()
+                    sps = cfg.print_interval * cfg.batch_size / window_dt
                     metrics.write("train", step=self.step, loss=loss, ewma=ewma,
                                   samples_per_sec=sps)
                     if self.step % cfg.validation_interval == 0:

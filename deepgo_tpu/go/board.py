@@ -11,6 +11,10 @@ Board representation: ``stones`` is a (19, 19) uint8 array with 0 empty,
 1 black, 2 white; axis 0 is the SGF x coordinate. ``age`` is a (19, 19) int32
 array counting how many moves each point has been in its current state
 (0 = never occupied, capped at 255; reference makedata.lua:329-339).
+
+Deliberately no ko/superko tracking, matching the reference: both engines
+replay *recorded* games, where move legality is guaranteed by the source;
+only occupied-point plays are rejected (reference makedata.lua:352).
 """
 
 from __future__ import annotations

@@ -41,6 +41,10 @@ class ModelConfig:
     input_planes: int = NUM_PLANES
     final_relu: bool = False  # True = bit-parity with the reference head
     compute_dtype: str = "bfloat16"
+    # rematerialize per-layer activations in backward (jax.checkpoint):
+    # trades ~1 extra forward for O(1-layer) activation memory — needed to
+    # train the "large" config at big batch sizes within one chip's HBM
+    remat: bool = False
 
     def layer_shapes(self):
         """[(kernel, c_in, c_out)] for each conv layer."""
@@ -90,7 +94,8 @@ def apply(params: dict, planes: jax.Array, cfg: ModelConfig) -> jax.Array:
     dtype = jnp.dtype(cfg.compute_dtype)
     x = planes.astype(dtype)
     n_layers = len(params["layers"])
-    for i, layer in enumerate(params["layers"]):
+
+    def conv_layer(x, layer, relu):
         x = jax.lax.conv_general_dilated(
             x,
             layer["w"].astype(dtype),
@@ -99,8 +104,12 @@ def apply(params: dict, planes: jax.Array, cfg: ModelConfig) -> jax.Array:
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         x = x + layer["b"].astype(dtype)[None]
-        if i < n_layers - 1 or cfg.final_relu:
-            x = jax.nn.relu(x)
+        return jax.nn.relu(x) if relu else x
+
+    if cfg.remat:
+        conv_layer = jax.checkpoint(conv_layer, static_argnums=(2,))
+    for i, layer in enumerate(params["layers"]):
+        x = conv_layer(x, layer, i < n_layers - 1 or cfg.final_relu)
     return x.reshape(x.shape[0], NUM_POINTS).astype(jnp.float32)
 
 

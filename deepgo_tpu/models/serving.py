@@ -1,0 +1,51 @@
+"""Batched policy inference (the serving path).
+
+The reference has no separate serving stack — batched ``model:forward`` over
+board tensors IS inference (SURVEY.md section 3.4). This module packages
+that capability properly: a jitted predict function from packed records to
+move probabilities and ranked moves, loadable straight from a checkpoint.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import get_expand_fn
+from . import policy_cnn
+
+
+def make_policy_fn(cfg: policy_cnn.ModelConfig, top_k: int = 5,
+                   expand_backend: str = "xla"):
+    """predict(params, packed, player, rank) ->
+    {"log_probs": (B, 361), "top_moves": (B, k), "top_probs": (B, k)}.
+
+    Moves are flat 0-based indices (19*x + y), matching the training target.
+    """
+    expand_planes = get_expand_fn(expand_backend)
+
+    @functools.partial(jax.jit, static_argnums=())
+    def predict(params, packed, player, rank):
+        planes = expand_planes(packed, player, rank,
+                               dtype=jnp.dtype(cfg.compute_dtype))
+        logp = policy_cnn.log_policy(params, planes, cfg)
+        top_probs, top_moves = jax.lax.top_k(jnp.exp(logp), top_k)
+        return {"log_probs": logp, "top_moves": top_moves,
+                "top_probs": top_probs}
+
+    return predict
+
+
+def load_policy(checkpoint_path: str, top_k: int = 5):
+    """(predict_fn, params, model_cfg) from a training checkpoint."""
+    from ..experiments import ExperimentConfig
+    from ..experiments import checkpoint as ckpt
+
+    meta, p_leaves, _ = ckpt.load_checkpoint(checkpoint_path)
+    config = ExperimentConfig.from_dict(meta["config"])
+    cfg = config.model_config()
+    template = policy_cnn.init(jax.random.key(0), cfg)
+    params = ckpt.unflatten_like(template, [jnp.asarray(x) for x in p_leaves])
+    return make_policy_fn(cfg, top_k=top_k), params, cfg

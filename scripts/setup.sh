@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# One-shot environment bootstrap (the reference's prep-instance.sh analogue,
+# minus cloud provisioning): build the native engine, transcribe the bundled
+# SGF corpus into training shards, and run the test suite.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== building native rules engine"
+make -C native
+
+echo "== transcribing bundled corpus"
+python -m deepgo_tpu.data.transcribe --src data/sgf --out data/processed \
+    --splits train,validation,test
+
+echo "== running tests"
+python -m pytest tests/ -q
+
+echo "== smoke training run (CPU-sized)"
+python -m deepgo_tpu.cli localtest --iters 20
+
+echo "setup complete"

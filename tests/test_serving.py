@@ -1,0 +1,77 @@
+"""Serving path and remat tests."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepgo_tpu.models import ModelConfig, init, apply
+from deepgo_tpu.models.serving import load_policy, make_policy_fn
+
+
+def _inputs(b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.integers(0, 3, size=(b, 9, 19, 19), dtype=np.uint8)),
+        jnp.asarray(rng.integers(1, 3, size=b).astype(np.int32)),
+        jnp.asarray(rng.integers(1, 10, size=b).astype(np.int32)),
+    )
+
+
+def test_policy_fn_outputs():
+    cfg = ModelConfig(num_layers=2, channels=8)
+    params = init(jax.random.key(0), cfg)
+    predict = make_policy_fn(cfg, top_k=3)
+    out = predict(params, *_inputs())
+    assert out["log_probs"].shape == (8, 361)
+    assert out["top_moves"].shape == (8, 3)
+    np.testing.assert_allclose(
+        np.exp(np.asarray(out["log_probs"])).sum(-1), 1.0, rtol=1e-4
+    )
+    # top-1 agrees with argmax of the distribution
+    assert np.array_equal(
+        np.asarray(out["top_moves"])[:, 0],
+        np.asarray(out["log_probs"]).argmax(-1),
+    )
+    # top probs sorted descending
+    tp = np.asarray(out["top_probs"])
+    assert (np.diff(tp, axis=1) <= 1e-7).all()
+
+
+def test_load_policy_from_checkpoint(tmp_path):
+    import os
+    from conftest import REPO_ROOT
+    from deepgo_tpu.data.transcribe import transcribe_split
+    from deepgo_tpu.experiments import Experiment
+    from test_experiment import tiny_config
+
+    root = tmp_path / "processed"
+    for split in ("validation", "test"):
+        transcribe_split(os.path.join(REPO_ROOT, "data/sgf", split),
+                         str(root / split), workers=1, verbose=False)
+    exp = Experiment(tiny_config(str(root), run_dir=str(tmp_path / "runs")))
+    exp.run(5)
+    path = exp.save()
+
+    predict, params, cfg = load_policy(path)
+    out = predict(params, *_inputs())
+    assert np.isfinite(np.asarray(out["log_probs"])).all()
+
+
+def test_remat_same_values_and_grads():
+    cfg = ModelConfig(num_layers=3, channels=16, compute_dtype="float32")
+    cfg_r = ModelConfig(num_layers=3, channels=16, compute_dtype="float32",
+                        remat=True)
+    params = init(jax.random.key(0), cfg)
+    planes = jnp.asarray(
+        np.random.default_rng(0).random((4, 19, 19, 37)), jnp.float32
+    )
+
+    def loss(p, c):
+        return apply(p, planes, c).sum()
+
+    v1, g1 = jax.value_and_grad(lambda p: loss(p, cfg))(params)
+    v2, g2 = jax.value_and_grad(lambda p: loss(p, cfg_r))(params)
+    assert float(v1) == float(v2)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
