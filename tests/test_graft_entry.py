@@ -1,0 +1,52 @@
+"""Driver-contract tests for __graft_entry__ (subprocess: dryrun mutates
+global backend config)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import REPO_ROOT
+
+
+def _run(code: str, extra_env: dict | None = None):
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO_ROOT, env=env, timeout=300,
+    )
+
+
+def test_entry_compiles_on_cpu():
+    r = _run(
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "assert out.shape == (128, 361), out.shape\n"
+        "print('OK')\n",
+        {"JAX_PLATFORMS": "cpu"},
+    )
+    assert "OK" in r.stdout, r.stderr[-2000:]
+
+
+@pytest.mark.parametrize("preset_env", [True, False])
+def test_dryrun_multichip(preset_env):
+    env = (
+        {"JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+        if preset_env
+        else {}
+    )
+    prelude = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        if preset_env
+        else ""
+    )
+    r = _run(
+        prelude + "import __graft_entry__ as g\ng.dryrun_multichip(8)\n",
+        env,
+    )
+    assert "one train step done" in r.stdout, r.stderr[-2000:]

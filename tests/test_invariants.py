@@ -1,0 +1,85 @@
+"""Property/invariant tests over randomized play.
+
+The reference guards its engine with inline asserts in the hot path
+(makedata.lua:309,352,397,418); here the same invariants — plus the global
+no-dead-chain board invariant the reference never checks — run over
+thousands of random positions.
+"""
+
+import numpy as np
+
+from deepgo_tpu.go import (
+    EMPTY,
+    find_groups,
+    group_and_liberties,
+    new_board,
+    play,
+    simulate_play,
+    summarize,
+)
+
+
+def _random_game(seed, n_moves=150):
+    rng = np.random.default_rng(seed)
+    stones, age = new_board()
+    player = 1
+    for _ in range(n_moves):
+        empties = np.argwhere(stones == EMPTY)
+        if len(empties) == 0:
+            break
+        x, y = empties[rng.integers(0, len(empties))]
+        play(stones, age, int(x), int(y), player)
+        player = 3 - player
+    return stones, age
+
+
+def test_no_dead_chains_after_any_move():
+    """After capture resolution, every chain on the board has >= 1 liberty."""
+    for seed in range(25):
+        stones, _ = _random_game(seed)
+        _, groups = find_groups(stones)
+        for g in groups:
+            assert len(g["liberties"]) >= 1, (seed, g["points"])
+
+
+def test_age_consistent_with_occupancy():
+    for seed in range(10):
+        stones, age = _random_game(seed)
+        # occupied points always have age >= 1
+        assert (age[stones != EMPTY] >= 1).all()
+
+
+def test_simulate_play_never_mutates():
+    for seed in range(10):
+        stones, _ = _random_game(seed, n_moves=80)
+        before = stones.copy()
+        for x in range(19):
+            for y in range(19):
+                if stones[x, y] == EMPTY:
+                    simulate_play(stones, x, y, 1)
+                    simulate_play(stones, x, y, 2)
+        assert np.array_equal(stones, before), seed
+
+
+def test_summarize_internal_consistency():
+    for seed in range(5):
+        stones, age = _random_game(seed, n_moves=100)
+        packed = summarize(stones, age)
+        # stones channel is the board
+        assert np.array_equal(packed[0], stones)
+        # liberties are zero exactly on empty points
+        assert ((packed[1] > 0) == (stones != EMPTY)).all()
+        # kills/liberties-after are zero on occupied points
+        for c in range(2, 6):
+            assert (packed[c][stones != EMPTY] == 0).all()
+        # a point with kills > 0 must border an opponent chain in atari
+        for player in (1, 2):
+            kills = packed[4 + player - 1]
+            for x, y in np.argwhere(kills > 0):
+                neighbors_in_atari = any(
+                    stones[nx, ny] == 3 - player
+                    and len(group_and_liberties(stones, nx, ny)[1]) == 1
+                    for nx, ny in [(x - 1, y), (x + 1, y), (x, y - 1), (x, y + 1)]
+                    if 0 <= nx < 19 and 0 <= ny < 19
+                )
+                assert neighbors_in_atari, (seed, x, y, player)
