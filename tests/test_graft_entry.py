@@ -11,7 +11,10 @@ from conftest import REPO_ROOT
 
 
 def _run(code: str, extra_env: dict | None = None):
-    env = dict(os.environ)
+    # drop conftest's own CPU forcing so the child genuinely starts from the
+    # platform the test case asks for
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     env.update(extra_env or {})
     return subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,

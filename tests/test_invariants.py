@@ -12,6 +12,7 @@ from deepgo_tpu.go import (
     EMPTY,
     find_groups,
     group_and_liberties,
+    neighbors,
     new_board,
     play,
     simulate_play,
@@ -79,7 +80,6 @@ def test_summarize_internal_consistency():
                 neighbors_in_atari = any(
                     stones[nx, ny] == 3 - player
                     and len(group_and_liberties(stones, nx, ny)[1]) == 1
-                    for nx, ny in [(x - 1, y), (x + 1, y), (x, y - 1), (x, y + 1)]
-                    if 0 <= nx < 19 and 0 <= ny < 19
+                    for nx, ny in neighbors(int(x), int(y))
                 )
                 assert neighbors_in_atari, (seed, x, y, player)
