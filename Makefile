@@ -1,0 +1,26 @@
+# Developer entry points. `scripts/setup.sh` chains native + data + test.
+
+.PHONY: native data test test-full bench smoke clean
+
+native:
+	$(MAKE) -C native
+
+data: native
+	python -m deepgo_tpu.data.transcribe --src data/sgf --out data/processed \
+	    --splits train,validation,test
+
+test:
+	python -m pytest tests/ -q
+
+test-full:  # every golden position, not the sampled sweep
+	DEEPGO_GOLDEN_FULL=1 python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+smoke: data
+	python -m deepgo_tpu.cli localtest --iters 20
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf data/processed

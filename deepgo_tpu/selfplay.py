@@ -1,0 +1,166 @@
+"""Batched self-play: the policy network playing itself.
+
+BASELINE.md config 5 ("batched self-play policy inference") realized as an
+actual driver, not just a forward-pass benchmark: N games advance in
+lockstep, the host summarizes each live board into a packed record (native
+C++ engine when available), one batched TPU forward scores all of them, and
+each game plays its best *legal* move (legality = empty and not suicide,
+straight from the packed liberties-after channel — no second rules query).
+
+Games end on double pass — a player passes when no legal move is left or
+when its best move's probability falls below ``pass_threshold`` — or at
+``max_moves``. Finished games can be exported as SGF, which feeds back into
+this framework's own transcription pipeline (full circle).
+
+Usage:
+  python -m deepgo_tpu.selfplay --games 32 [--checkpoint runs/<id>/checkpoint.npz]
+      [--max-moves 200] [--sgf-out selfplay_games/] [--temperature 0.5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import BOARD_SIZE
+from .features import P_LIB_AFTER, P_STONES
+from .go import native, new_board, play, summarize
+from .models import policy_cnn
+from .models.serving import make_policy_fn
+from .sgf import Move, coord_to_sgf
+
+
+class GameState:
+    def __init__(self):
+        self.stones, self.age = new_board()
+        self.player = 1
+        self.moves: list[Move] = []
+        self.passes = 0
+        self.done = False
+
+
+def _summarize(state: GameState) -> np.ndarray:
+    if native.available():
+        return native.summarize_native(state.stones, state.age)
+    return summarize(state.stones, state.age)
+
+
+def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
+              max_moves: int = 361, temperature: float = 0.0,
+              pass_threshold: float = 1e-4, rank: int = 9, seed: int = 0):
+    """Play n_games to completion; returns (games, stats)."""
+    predict = make_policy_fn(cfg, top_k=1)
+    rng = np.random.default_rng(seed)
+    games = [GameState() for _ in range(n_games)]
+    positions = 0
+    t0 = time.time()
+
+    while True:
+        active = [g for g in games if not g.done]
+        if not active:
+            break
+        packed = np.stack([_summarize(g) for g in active])
+        players = np.array([g.player for g in active], dtype=np.int32)
+        ranks = np.full(len(active), rank, dtype=np.int32)
+        logp = np.asarray(
+            predict(params, jnp.asarray(packed), jnp.asarray(players),
+                    jnp.asarray(ranks))["log_probs"]
+        )
+        positions += len(active)
+
+        # legality: empty and not suicide (liberties-after > 0)
+        empty = packed[:, P_STONES].reshape(len(active), -1) == 0
+        lib_after = np.stack(
+            [packed[i, P_LIB_AFTER + g.player - 1].reshape(-1)
+             for i, g in enumerate(active)]
+        )
+        legal = empty & (lib_after > 0)
+        logp = np.where(legal, logp, -np.inf)
+
+        for i, g in enumerate(active):
+            row = logp[i]
+            if temperature > 0:
+                z = row / temperature
+                z -= z.max() if np.isfinite(z.max()) else 0
+                p = np.exp(z)
+                total = p.sum()
+                move_idx = int(rng.choice(361, p=p / total)) if total > 0 else -1
+            else:
+                move_idx = int(row.argmax()) if np.isfinite(row.max()) else -1
+            best_prob = float(np.exp(row[move_idx])) if move_idx >= 0 else 0.0
+
+            if move_idx < 0 or best_prob < pass_threshold:
+                g.passes += 1  # pass (not recorded on the board, like the reference)
+                if g.passes >= 2:
+                    g.done = True
+            else:
+                g.passes = 0
+                x, y = divmod(move_idx, BOARD_SIZE)
+                play(g.stones, g.age, x, y, g.player)
+                g.moves.append(Move(g.player, x, y))
+                if len(g.moves) >= max_moves:
+                    g.done = True
+            g.player = 3 - g.player
+
+    dt = time.time() - t0
+    stats = {
+        "games": n_games,
+        "positions": positions,
+        "seconds": dt,
+        "positions_per_sec": positions / dt,
+        "mean_moves": float(np.mean([len(g.moves) for g in games])),
+    }
+    return games, stats
+
+
+def to_sgf(game: GameState, black_rank: int = 9, white_rank: int = 9) -> str:
+    lines = ["(;GM[1]", "FF[4]", "CA[UTF-8]", "SZ[19]",
+             f"BR[{black_rank}d]", f"WR[{white_rank}d]"]
+    for m in game.moves:
+        tag = "B" if m.player == 1 else "W"
+        lines.append(f";{tag}[{coord_to_sgf(m.x, m.y)}]")
+    return "\r\n".join(lines) + ")\r\n"
+
+
+def main(argv=None) -> None:
+    import os
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--games", type=int, default=32)
+    ap.add_argument("--checkpoint", help="policy checkpoint (default: random init)")
+    ap.add_argument("--model", default="small", choices=list(policy_cnn.CONFIGS))
+    ap.add_argument("--max-moves", type=int, default=361)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--sgf-out", help="directory to write finished games")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    if args.checkpoint:
+        from .models.serving import load_policy
+
+        _, params, cfg = load_policy(args.checkpoint)
+    else:
+        cfg = policy_cnn.CONFIGS[args.model]
+        params = policy_cnn.init(jax.random.key(args.seed), cfg)
+
+    games, stats = self_play(params, cfg, n_games=args.games,
+                             max_moves=args.max_moves,
+                             temperature=args.temperature, seed=args.seed)
+    print({k: round(v, 2) if isinstance(v, float) else v
+           for k, v in stats.items()})
+
+    if args.sgf_out:
+        os.makedirs(args.sgf_out, exist_ok=True)
+        for i, g in enumerate(games):
+            with open(os.path.join(args.sgf_out, f"game_{i:04d}.sgf"), "w") as f:
+                f.write(to_sgf(g))
+        print(f"wrote {len(games)} SGFs to {args.sgf_out}")
+
+
+if __name__ == "__main__":
+    main()
