@@ -34,6 +34,9 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     curves = load_curves(args.runs)
+    out_dir = os.path.dirname(args.out)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
     csv_path = args.out + ".csv"
     with open(csv_path, "w") as f:
         f.write("run,step,validation_cost,validation_accuracy\n")

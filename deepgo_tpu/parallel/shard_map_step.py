@@ -18,8 +18,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
 
 from ..models import policy_cnn
 from ..ops import get_expand_fn
@@ -62,7 +62,7 @@ def make_shard_map_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
         mesh=mesh,
         in_specs=(P(), P(), batch_spec),
         out_specs=(P(), P(), P()),
-        check_rep=False,
+        check_vma=False,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
