@@ -1,0 +1,59 @@
+"""SGF parser robustness: arbitrary bytes must never crash the parser, and
+malformed games must be skipped, not transcribed."""
+
+import numpy as np
+
+from deepgo_tpu import sgf
+from deepgo_tpu.data.transcribe import transcribe_game
+
+
+def test_parser_never_raises_on_garbage():
+    rng = np.random.default_rng(0)
+    for i in range(200):
+        n = int(rng.integers(0, 400))
+        blob = bytes(rng.integers(0, 256, size=n, dtype=np.uint8))
+        text = blob.decode("latin-1")
+        game = sgf.parse(text)  # must not raise
+        assert isinstance(game.moves, list)
+
+
+def test_parser_handles_adversarial_fragments():
+    cases = [
+        "",
+        "(;)",
+        "(;B[)",
+        "(;B[aa",
+        ";W[zz];B[a]",          # off-alphabet / wrong-length coords -> dropped
+        "(;B[aa];B[aa])",       # same point twice: parser keeps both...
+        "(;BR[d]WR[0d];B[aa])",  # malformed / out-of-range ranks
+        "(;C[\\]]);B[cc]",
+        "(" * 50 + ";B[aa]" + ")" * 50,
+    ]
+    for text in cases:
+        game = sgf.parse(text)
+        assert all(0 <= m.x < 19 and 0 <= m.y < 19 for m in game.moves), text
+
+
+def test_transcribe_rejects_illegal_replay(tmp_path):
+    # ...but the rules engine rejects the illegal double-play at replay time
+    p = tmp_path / "bad.sgf"
+    p.write_text("(;BR[1d]WR[1d];B[aa];W[aa])")
+    import pytest
+    from deepgo_tpu.go import IllegalMoveError
+
+    with pytest.raises(IllegalMoveError):
+        transcribe_game(str(p), engine="python")
+
+
+def test_transcribe_split_survives_corrupt_file(tmp_path):
+    """A corrupt SGF in a split is skipped with a stderr note; the rest
+    transcribe (the pool worker catches per-game errors)."""
+    from deepgo_tpu.data.transcribe import transcribe_split
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "good.sgf").write_text("(;BR[3d]WR[4d];B[pd];W[dd];B[pp])")
+    (src / "bad.sgf").write_text("(;BR[1d]WR[1d];B[aa];W[aa])")
+    n = transcribe_split(str(src), str(tmp_path / "out"), workers=1,
+                         verbose=False)
+    assert n == 3  # the good game's moves only
