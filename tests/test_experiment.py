@@ -114,6 +114,24 @@ def test_warm_restart_fresh_optimizer_new_id(data_root, tmp_path):
     assert b["cost"] == pytest.approx(a["cost"], rel=1e-5)
 
 
+def test_bad_batch_postmortem_capture(data_root, tmp_path):
+    """A failing train step dumps the offending batch to bad_batch.npz
+    (the reference kept it in globals, train.lua:106-109)."""
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
+    exp = Experiment(cfg)
+    exp.init()
+
+    def exploding_step(params, opt_state, batch):
+        raise FloatingPointError("synthetic step failure")
+
+    exp.train_step = exploding_step
+    with pytest.raises(FloatingPointError):
+        exp.run(5)
+    dump = np.load(os.path.join(exp.run_path, "bad_batch.npz"))
+    assert dump["packed"].shape == (cfg.batch_size, 9, 19, 19)
+    assert set(dump.files) >= {"packed", "player", "rank", "target"}
+
+
 def test_evaluate_full_split(data_root, tmp_path):
     cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
     exp = Experiment(cfg)

@@ -45,7 +45,7 @@ def test_transcribe_rejects_illegal_replay(tmp_path):
         transcribe_game(str(p), engine="python")
 
 
-def test_transcribe_split_survives_corrupt_file(tmp_path):
+def test_transcribe_split_survives_corrupt_file(tmp_path, capsys):
     """A corrupt SGF in a split is skipped with a stderr note; the rest
     transcribe (the pool worker catches per-game errors)."""
     from deepgo_tpu.data.transcribe import transcribe_split
@@ -57,3 +57,7 @@ def test_transcribe_split_survives_corrupt_file(tmp_path):
     n = transcribe_split(str(src), str(tmp_path / "out"), workers=1,
                          verbose=False)
     assert n == 3  # the good game's moves only
+    # pin the error path: bad.sgf must have gone through the exception
+    # catch, not a silent None-result skip
+    err = capsys.readouterr().err
+    assert "bad.sgf" in err and "IllegalMoveError" in err
