@@ -1,0 +1,230 @@
+"""Match harness: batched games between two agents, scored, win rates out.
+
+The reference paper's headline evaluation is win rate of the raw policy net
+against an opponent (97% vs GnuGo, README.md:5 / arXiv:1412.6564); the
+reference repo has no machinery for it. This is that machinery, TPU-shaped:
+N games advance in lockstep, colors alternate across games (game i gives
+black to agent ``i % 2``), each ply batches all boards where a given agent
+is to move into one TPU forward (for policy agents) or one vectorized host
+step (for baselines), and finished games are Tromp-Taylor scored
+(``go.scoring.area_score``) to produce W/L and margins.
+
+Baselines (GnuGo is not installable in this environment — zero egress):
+  * ``RandomAgent`` — uniform over legal moves.
+  * ``HeuristicAgent`` — max captures, then max liberties-after, random
+    tie-break: a capture-greedy opponent clearly stronger than random.
+
+Usage:
+  python -m deepgo_tpu.arena --a checkpoint:runs/<id>/checkpoint.npz \
+      --b random --games 64 [--komi 7.5] [--sgf-out arena_games/]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+
+from .features import P_KILLS, P_LIB_AFTER
+from .go import BLACK, WHITE
+from .go.scoring import Score, area_score
+from .models import policy_cnn
+from .selfplay import (GameState, batched_log_probs, legal_mask,
+                       select_from_log_probs, step_game, summarize_state,
+                       to_sgf)
+
+
+class Agent:
+    """Batched move selection: packed boards in, move indices out (-1 = pass)."""
+
+    name = "agent"
+
+    def select_moves(self, packed: np.ndarray, players: np.ndarray,
+                     legal: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        raise NotImplementedError
+
+
+class RandomAgent(Agent):
+    name = "random"
+
+    def select_moves(self, packed, players, legal, rng):
+        moves = np.full(len(packed), -1, dtype=np.int64)
+        for i in range(len(packed)):
+            choices = np.flatnonzero(legal[i])
+            if choices.size:
+                moves[i] = rng.choice(choices)
+        return moves
+
+
+class HeuristicAgent(Agent):
+    """Capture-greedy: max kills, then max liberties-after, random tie-break."""
+
+    name = "heuristic"
+
+    def select_moves(self, packed, players, legal, rng):
+        n = len(packed)
+        idx = np.arange(n)
+        kills = packed[idx, P_KILLS + players - 1].reshape(n, -1).astype(np.int64)
+        libs = packed[idx, P_LIB_AFTER + players - 1].reshape(n, -1).astype(np.int64)
+        # lexicographic (kills, libs, jitter) over legal points
+        score = np.where(legal, (kills << 20) + (libs << 10), -1)
+        moves = np.full(n, -1, dtype=np.int64)
+        for i in range(n):
+            best = score[i].max()
+            if best >= 0:
+                moves[i] = rng.choice(np.flatnonzero(score[i] == best))
+        return moves
+
+
+class PolicyAgent(Agent):
+    """The trained CNN, one batched TPU forward per ply."""
+
+    def __init__(self, params, cfg: policy_cnn.ModelConfig, name: str = "policy",
+                 temperature: float = 0.0, pass_threshold: float = 1e-4,
+                 rank: int = 9):
+        from .models.serving import make_policy_fn
+
+        self.params = params
+        self.cfg = cfg
+        self.name = name
+        self.temperature = temperature
+        self.pass_threshold = pass_threshold
+        self.rank = rank
+        self._predict = make_policy_fn(cfg, top_k=1)
+
+    def select_moves(self, packed, players, legal, rng):
+        ranks = np.full(len(packed), self.rank, dtype=np.int32)
+        logp = batched_log_probs(self._predict, self.params, packed, players,
+                                 ranks)
+        logp = np.where(legal, logp, -np.inf)
+        moves = np.full(len(packed), -1, dtype=np.int64)
+        for i in range(len(packed)):
+            moves[i] = select_from_log_probs(logp[i], self.temperature,
+                                             self.pass_threshold, rng)
+        return moves
+
+
+def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
+               komi: float = 7.5, max_moves: int = 450, seed: int = 0):
+    """Run n_games with alternating colors; returns (games, scores, stats).
+
+    Game i gives black to agent_a when i is even. Every active game advances
+    one ply per iteration, so all active boards share a side-to-move and each
+    agent sees at most one batch per ply.
+    """
+    rng = np.random.default_rng(seed)
+    games = [GameState() for _ in range(n_games)]
+    # black_agent[i] plays BLACK in game i
+    agent_of = [(agent_a, agent_b) if i % 2 == 0 else (agent_b, agent_a)
+                for i in range(n_games)]
+    plies = 0
+    t0 = time.time()
+
+    while True:
+        live = [i for i, g in enumerate(games) if not g.done]
+        if not live:
+            break
+        packed = np.stack([summarize_state(games[i]) for i in live])
+        players = np.array([games[i].player for i in live], dtype=np.int32)
+        legal = legal_mask(packed, players, [games[i] for i in live])
+        plies += len(live)
+
+        moves = np.full(len(live), -1, dtype=np.int64)
+        agents = (agent_a,) if agent_b is agent_a else (agent_a, agent_b)
+        for agent in agents:
+            sel = [j for j, i in enumerate(live)
+                   if agent_of[i][games[i].player - 1] is agent]
+            if sel:
+                moves[sel] = agent.select_moves(
+                    packed[sel], players[sel], legal[sel], rng)
+
+        for j, i in enumerate(live):
+            step_game(games[i], int(moves[j]), max_moves)
+
+    scores = [area_score(g.stones, komi=komi) for g in games]
+    dt = time.time() - t0
+
+    a_wins = b_wins = draws = 0
+    a_black_wins = 0
+    margins = []
+    for i, s in enumerate(scores):
+        winner = s.winner
+        black, white = agent_of[i]
+        margins.append(s.margin if black is agent_a else -s.margin)
+        if winner == 0:
+            draws += 1
+        elif (black if winner == BLACK else white) is agent_a:
+            a_wins += 1
+            if winner == BLACK and black is agent_a:
+                a_black_wins += 1
+        else:
+            b_wins += 1
+    name_a = agent_a.name
+    name_b = agent_b.name if agent_b.name != name_a else agent_b.name + "-b"
+    stats = {
+        "games": n_games,
+        f"{name_a}_wins": a_wins,
+        f"{name_b}_wins": b_wins,
+        "draws": draws,
+        f"{name_a}_win_rate": a_wins / n_games,
+        f"{name_a}_wins_as_black": a_black_wins,
+        "mean_margin_for_a": float(np.mean(margins)),
+        "plies": plies,
+        "seconds": dt,
+        "positions_per_sec": plies / dt,
+    }
+    return games, scores, stats
+
+
+def _make_agent(spec: str, seed: int) -> Agent:
+    if spec == "random":
+        return RandomAgent()
+    if spec == "heuristic":
+        return HeuristicAgent()
+    if spec.startswith("checkpoint:"):
+        from .models.serving import load_policy
+
+        _, params, cfg = load_policy(spec.split(":", 1)[1])
+        return PolicyAgent(params, cfg, name="policy")
+    if spec.startswith("model:"):  # random-init policy, for smoke runs
+        cfg = policy_cnn.CONFIGS[spec.split(":", 1)[1]]
+        params = policy_cnn.init(jax.random.key(seed), cfg)
+        return PolicyAgent(params, cfg, name=f"init-{spec.split(':', 1)[1]}")
+    raise ValueError(f"unknown agent spec {spec!r} "
+                     "(use random | heuristic | checkpoint:PATH | model:NAME)")
+
+
+def main(argv=None) -> None:
+    import os
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--a", default="model:small", help="agent A spec")
+    ap.add_argument("--b", default="random", help="agent B spec")
+    ap.add_argument("--games", type=int, default=32)
+    ap.add_argument("--komi", type=float, default=7.5)
+    ap.add_argument("--max-moves", type=int, default=450)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sgf-out", help="directory to write scored games")
+    args = ap.parse_args(argv)
+
+    agent_a = _make_agent(args.a, args.seed)
+    agent_b = _make_agent(args.b, args.seed + 1)
+    games, scores, stats = play_match(agent_a, agent_b, n_games=args.games,
+                                      komi=args.komi, max_moves=args.max_moves,
+                                      seed=args.seed)
+    print({k: round(v, 3) if isinstance(v, float) else v
+           for k, v in stats.items()})
+
+    if args.sgf_out:
+        os.makedirs(args.sgf_out, exist_ok=True)
+        for i, (g, s) in enumerate(zip(games, scores)):
+            with open(os.path.join(args.sgf_out, f"match_{i:04d}.sgf"), "w") as f:
+                f.write(to_sgf(g, result=s.result_string(), komi=args.komi))
+        print(f"wrote {len(games)} scored SGFs to {args.sgf_out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 
 from . import BOARD_SIZE
 from .features import P_LIB_AFTER, P_STONES
-from .go import native, new_board, play, summarize
+from .go import (group_and_liberties, native, neighbors, new_board, play,
+                 summarize)
 from .models import policy_cnn
 from .models.serving import make_policy_fn
 from .sgf import Move, coord_to_sgf
@@ -42,12 +43,114 @@ class GameState:
         self.moves: list[Move] = []
         self.passes = 0
         self.done = False
+        self.ko_point: tuple[int, int] | None = None
 
 
-def _summarize(state: GameState) -> np.ndarray:
+def apply_move(g: GameState, x: int, y: int) -> None:
+    """Play the side-to-move's stone in game ``g`` with simple-ko tracking.
+
+    The rules engine deliberately has no ko (it replays *recorded* games,
+    board.py:15-18), but generated games need it: without a ko ban two
+    deterministic agents recapture the same stone forever. After a play that
+    captures exactly one stone and leaves the new stone as a lone chain with
+    exactly one liberty, that captured point is banned for the opponent's
+    immediate reply (simple ko; superko is not needed for policy-net play).
+    """
+    would_die: set[tuple[int, int]] = set()
+    for n in neighbors(x, y):
+        if g.stones[n] == 3 - g.player and n not in would_die:
+            grp, libs = group_and_liberties(g.stones, *n)
+            if libs == {(x, y)}:
+                would_die |= grp
+    play(g.stones, g.age, x, y, g.player)
+    g.ko_point = None
+    if len(would_die) == 1:
+        grp, libs = group_and_liberties(g.stones, x, y)
+        if len(grp) == 1 and len(libs) == 1:
+            g.ko_point = next(iter(would_die))
+    g.moves.append(Move(g.player, x, y))
+
+
+def step_game(g: GameState, move_idx: int, max_moves: int) -> None:
+    """Advance one ply: play ``move_idx`` or record a pass (-1); end the
+    game on double pass or the move cap; flip the side to move."""
+    if move_idx < 0:
+        g.passes += 1
+        g.ko_point = None  # a pass lifts the ko ban for the next player
+        if g.passes >= 2:
+            g.done = True
+    else:
+        g.passes = 0
+        x, y = divmod(move_idx, BOARD_SIZE)
+        apply_move(g, x, y)
+        if len(g.moves) >= max_moves:
+            g.done = True
+    g.player = 3 - g.player
+
+
+def summarize_state(state: GameState) -> np.ndarray:
     if native.available():
         return native.summarize_native(state.stones, state.age)
     return summarize(state.stones, state.age)
+
+
+def legal_mask(packed: np.ndarray, players: np.ndarray,
+               games: list[GameState] | None = None) -> np.ndarray:
+    """(N, 361) bool: empty, not suicide, and not a banned ko recapture.
+
+    Emptiness and suicide come from the packed planes alone; the ko ban
+    comes from each game's ``ko_point`` when ``games`` is given.
+    """
+    n = packed.shape[0]
+    empty = packed[:, P_STONES].reshape(n, -1) == 0
+    lib_after = packed[np.arange(n), P_LIB_AFTER + players - 1].reshape(n, -1)
+    legal = empty & (lib_after > 0)
+    if games is not None:
+        for i, g in enumerate(games):
+            if g.ko_point is not None:
+                legal[i, g.ko_point[0] * BOARD_SIZE + g.ko_point[1]] = False
+    return legal
+
+
+def batched_log_probs(predict, params, packed: np.ndarray,
+                      players: np.ndarray, ranks: np.ndarray) -> np.ndarray:
+    """Policy log-probs with the batch padded to the next power of two.
+
+    Game batches shrink irregularly as games finish; padding keeps the
+    number of distinct shapes ``jit`` ever sees at O(log n) instead of
+    recompiling for every batch size.
+    """
+    n = len(packed)
+    cap = 1 << max(0, n - 1).bit_length() if n > 1 else 1
+    if cap > n:
+        packed = np.concatenate(
+            [packed, np.zeros((cap - n,) + packed.shape[1:], packed.dtype)])
+        players = np.concatenate([players, np.ones(cap - n, players.dtype)])
+        ranks = np.concatenate([ranks, np.ones(cap - n, ranks.dtype)])
+    out = predict(params, jnp.asarray(packed), jnp.asarray(players),
+                  jnp.asarray(ranks))
+    return np.asarray(out["log_probs"])[:n]
+
+
+def select_from_log_probs(row: np.ndarray, temperature: float,
+                          pass_threshold: float,
+                          rng: np.random.Generator) -> int:
+    """Pick a move from one masked (-inf = illegal) log-prob row.
+
+    Returns a flat move index, or -1 to pass (no legal move, or the chosen
+    move's probability falls below ``pass_threshold``).
+    """
+    if not np.isfinite(row.max()):
+        return -1
+    if temperature > 0:
+        z = (row - row.max()) / temperature
+        p = np.exp(z)
+        move = int(rng.choice(361, p=p / p.sum()))
+    else:
+        move = int(row.argmax())
+    if float(np.exp(row[move])) < pass_threshold:
+        return -1
+    return move
 
 
 def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
@@ -64,48 +167,19 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
         active = [g for g in games if not g.done]
         if not active:
             break
-        packed = np.stack([_summarize(g) for g in active])
+        packed = np.stack([summarize_state(g) for g in active])
         players = np.array([g.player for g in active], dtype=np.int32)
         ranks = np.full(len(active), rank, dtype=np.int32)
-        logp = np.asarray(
-            predict(params, jnp.asarray(packed), jnp.asarray(players),
-                    jnp.asarray(ranks))["log_probs"]
-        )
+        logp = batched_log_probs(predict, params, packed, players, ranks)
         positions += len(active)
 
-        # legality: empty and not suicide (liberties-after > 0)
-        empty = packed[:, P_STONES].reshape(len(active), -1) == 0
-        lib_after = np.stack(
-            [packed[i, P_LIB_AFTER + g.player - 1].reshape(-1)
-             for i, g in enumerate(active)]
-        )
-        legal = empty & (lib_after > 0)
+        legal = legal_mask(packed, players, active)
         logp = np.where(legal, logp, -np.inf)
 
         for i, g in enumerate(active):
-            row = logp[i]
-            if temperature > 0:
-                z = row / temperature
-                z -= z.max() if np.isfinite(z.max()) else 0
-                p = np.exp(z)
-                total = p.sum()
-                move_idx = int(rng.choice(361, p=p / total)) if total > 0 else -1
-            else:
-                move_idx = int(row.argmax()) if np.isfinite(row.max()) else -1
-            best_prob = float(np.exp(row[move_idx])) if move_idx >= 0 else 0.0
-
-            if move_idx < 0 or best_prob < pass_threshold:
-                g.passes += 1  # pass (not recorded on the board, like the reference)
-                if g.passes >= 2:
-                    g.done = True
-            else:
-                g.passes = 0
-                x, y = divmod(move_idx, BOARD_SIZE)
-                play(g.stones, g.age, x, y, g.player)
-                g.moves.append(Move(g.player, x, y))
-                if len(g.moves) >= max_moves:
-                    g.done = True
-            g.player = 3 - g.player
+            move_idx = select_from_log_probs(logp[i], temperature,
+                                             pass_threshold, rng)
+            step_game(g, move_idx, max_moves)
 
     dt = time.time() - t0
     stats = {
@@ -118,9 +192,14 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
     return games, stats
 
 
-def to_sgf(game: GameState, black_rank: int = 9, white_rank: int = 9) -> str:
+def to_sgf(game: GameState, black_rank: int = 9, white_rank: int = 9,
+           result: str | None = None, komi: float | None = None) -> str:
     lines = ["(;GM[1]", "FF[4]", "CA[UTF-8]", "SZ[19]",
              f"BR[{black_rank}d]", f"WR[{white_rank}d]"]
+    if komi is not None:
+        lines.append(f"KM[{komi:g}]")
+    if result is not None:
+        lines.append(f"RE[{result}]")
     for m in game.moves:
         tag = "B" if m.player == 1 else "W"
         lines.append(f";{tag}[{coord_to_sgf(m.x, m.y)}]")

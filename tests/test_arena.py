@@ -1,0 +1,178 @@
+"""Tromp-Taylor scoring and the match/arena harness."""
+
+import numpy as np
+import pytest
+
+from deepgo_tpu.go import BLACK, WHITE, new_board, play
+from deepgo_tpu.go.scoring import area_score
+from deepgo_tpu import arena, sgf
+from deepgo_tpu.selfplay import to_sgf
+
+
+class TestAreaScore:
+    def test_empty_board_white_wins_by_komi(self):
+        stones, _ = new_board()
+        s = area_score(stones, komi=7.5)
+        assert (s.black, s.white) == (0.0, 0.0)
+        assert s.winner == WHITE
+        assert s.result_string() == "W+7.5"
+
+    def test_single_stone_owns_whole_board(self):
+        stones, age = new_board()
+        play(stones, age, 3, 3, BLACK)
+        s = area_score(stones, komi=7.5)
+        assert s.black == 361.0 and s.white == 0.0
+        assert s.winner == BLACK
+        assert s.result_string() == "B+353.5"
+
+    def test_region_touching_both_colors_is_neutral(self):
+        stones, age = new_board()
+        play(stones, age, 0, 0, BLACK)
+        play(stones, age, 18, 18, WHITE)
+        s = area_score(stones, komi=7.5)
+        assert (s.black, s.white) == (1.0, 1.0)
+        assert s.winner == WHITE  # komi decides
+
+    def test_wall_partitions_territory(self):
+        stones, age = new_board()
+        for y in range(19):
+            play(stones, age, 9, y, BLACK)
+        play(stones, age, 14, 14, WHITE)
+        s = area_score(stones, komi=7.5)
+        # x<9 empty region reaches only black; x>9 region reaches both
+        assert s.black == 9 * 19 + 19
+        assert s.white == 1.0
+
+    def test_draw(self):
+        stones, age = new_board()
+        play(stones, age, 0, 0, BLACK)
+        play(stones, age, 18, 18, WHITE)
+        s = area_score(stones, komi=0.0)
+        assert s.margin == 0.0 and s.winner == 0
+        assert s.result_string() == "0"
+
+    def test_captured_area_flips_owner(self):
+        stones, age = new_board()
+        # white stone at (0,0) captured by black (0,1)+(1,0)
+        play(stones, age, 0, 0, WHITE)
+        play(stones, age, 0, 1, BLACK)
+        play(stones, age, 1, 0, BLACK)
+        s = area_score(stones, komi=0.0)
+        assert s.white == 0.0 and s.black == 361.0
+
+
+class TestArena:
+    def test_random_vs_heuristic_match(self):
+        games, scores, stats = arena.play_match(
+            arena.RandomAgent(), arena.HeuristicAgent(),
+            n_games=4, max_moves=30, seed=1)
+        assert stats["games"] == 4
+        assert (stats["random_wins"] + stats["heuristic_wins"]
+                + stats["draws"]) == 4
+        assert len(scores) == 4
+        for g in games:
+            assert g.done and len(g.moves) <= 30
+
+    def test_colors_alternate_across_games(self):
+        class FirstLegal(arena.Agent):
+            name = "first"
+
+            def __init__(self):
+                self.colors_seen = set()
+
+            def select_moves(self, packed, players, legal, rng):
+                self.colors_seen.update(int(p) for p in players)
+                moves = np.full(len(packed), -1, dtype=np.int64)
+                for i in range(len(packed)):
+                    nz = np.flatnonzero(legal[i])
+                    if nz.size:
+                        moves[i] = nz[0]
+                return moves
+
+        a, b = FirstLegal(), arena.RandomAgent()
+        arena.play_match(a, b, n_games=2, max_moves=6, seed=0)
+        assert a.colors_seen == {1, 2}  # plays black in game 0, white in game 1
+
+    def test_heuristic_prefers_capture(self):
+        # white at (0,0) in atari: black to move must capture at (1,0)
+        from deepgo_tpu.selfplay import legal_mask, summarize_state
+
+        g = arena.GameState()
+        play(g.stones, g.age, 0, 0, WHITE)
+        play(g.stones, g.age, 0, 1, BLACK)
+        packed = summarize_state(g)[None]
+        players = np.array([1], dtype=np.int32)
+        legal = legal_mask(packed, players)
+        moves = arena.HeuristicAgent().select_moves(
+            packed, players, legal, np.random.default_rng(0))
+        assert moves[0] == 19 * 1 + 0
+
+    def test_policy_agent_smoke(self):
+        import jax
+
+        from deepgo_tpu.models import policy_cnn
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        agent = arena.PolicyAgent(params, cfg, name="p")
+        games, scores, stats = arena.play_match(
+            agent, arena.RandomAgent(), n_games=2, max_moves=6, seed=0)
+        assert stats["games"] == 2
+        assert all(g.done for g in games)
+
+    def test_scored_sgf_roundtrip(self):
+        games, scores, _ = arena.play_match(
+            arena.RandomAgent(), arena.RandomAgent(),
+            n_games=1, max_moves=10, seed=3)
+        text = to_sgf(games[0], result=scores[0].result_string(), komi=7.5)
+        parsed = sgf.parse(text)
+        assert len(parsed.moves) == len(games[0].moves)
+
+    def test_simple_ko_ban(self):
+        from deepgo_tpu.selfplay import apply_move, legal_mask, summarize_state
+
+        g = arena.GameState()
+        for x, y in [(1, 2), (2, 1), (2, 3)]:
+            play(g.stones, g.age, x, y, BLACK)
+        for x, y in [(2, 2), (3, 1), (3, 3), (4, 2)]:
+            play(g.stones, g.age, x, y, WHITE)
+        g.player = 1
+        apply_move(g, 3, 2)  # black captures the ko stone at (2,2)
+        assert g.ko_point == (2, 2)
+        g.player = 2
+        packed = summarize_state(g)[None]
+        legal = legal_mask(packed, np.array([2], dtype=np.int32), [g])
+        assert not legal[0, 19 * 2 + 2]  # immediate recapture banned
+        assert legal[0, 19 * 10 + 10]
+        g.player = 2
+        apply_move(g, 10, 10)  # any other move clears the ban
+        assert g.ko_point is None
+
+    def test_batched_log_probs_padding_matches_direct(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deepgo_tpu.models import policy_cnn
+        from deepgo_tpu.models.serving import make_policy_fn
+        from deepgo_tpu.selfplay import batched_log_probs
+
+        cfg = policy_cnn.ModelConfig(num_layers=1, channels=4)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        predict = make_policy_fn(cfg, top_k=1)
+        rng = np.random.default_rng(0)
+        packed = rng.integers(0, 2, size=(3, 9, 19, 19), dtype=np.uint8)
+        players = np.array([1, 2, 1], dtype=np.int32)
+        ranks = np.array([9, 9, 9], dtype=np.int32)
+        padded = batched_log_probs(predict, params, packed, players, ranks)
+        direct = np.asarray(predict(params, jnp.asarray(packed),
+                                    jnp.asarray(players),
+                                    jnp.asarray(ranks))["log_probs"])
+        assert padded.shape == (3, 361)
+        np.testing.assert_allclose(padded, direct, rtol=1e-5, atol=1e-5)
+
+    def test_make_agent_specs(self):
+        assert isinstance(arena._make_agent("random", 0), arena.RandomAgent)
+        assert isinstance(arena._make_agent("heuristic", 0),
+                          arena.HeuristicAgent)
+        with pytest.raises(ValueError):
+            arena._make_agent("gnugo", 0)
