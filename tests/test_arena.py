@@ -170,6 +170,20 @@ class TestArena:
         assert padded.shape == (3, 361)
         np.testing.assert_allclose(padded, direct, rtol=1e-5, atol=1e-5)
 
+    def test_generated_sgf_feeds_transcription(self, tmp_path):
+        # the "full circle": arena games -> SGF -> training shard records
+        from deepgo_tpu.data.transcribe import transcribe_game
+
+        games, scores, _ = arena.play_match(
+            arena.RandomAgent(), arena.HeuristicAgent(),
+            n_games=1, max_moves=40, seed=5)
+        path = tmp_path / "g.sgf"
+        path.write_text(to_sgf(games[0], result=scores[0].result_string(),
+                               komi=7.5))
+        packed, meta = transcribe_game(str(path))
+        assert packed.shape == (len(games[0].moves), 9, 19, 19)
+        assert meta.shape[0] == len(games[0].moves)
+
     def test_make_agent_specs(self):
         assert isinstance(arena._make_agent("random", 0), arena.RandomAgent)
         assert isinstance(arena._make_agent("heuristic", 0),

@@ -1,0 +1,45 @@
+"""Validation-curve plotting (the reference's plot.lua capability)."""
+
+import json
+import os
+
+from deepgo_tpu.experiments import plot
+
+
+def _write_metrics(run_dir, rows):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "metrics.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_load_curves_filters_validation_rows(tmp_path):
+    run = tmp_path / "abc123"
+    _write_metrics(run, [
+        {"kind": "train", "step": 10, "ewma": 5.0},
+        {"kind": "validation", "step": 100, "cost": 3.5, "accuracy": 0.1},
+        {"kind": "train", "step": 110, "ewma": 4.0},
+        {"kind": "validation", "step": 200, "cost": 3.1, "accuracy": 0.2},
+    ])
+    curves = plot.load_curves([str(run)])
+    assert curves == {"abc123": [(100, 3.5, 0.1), (200, 3.1, 0.2)]}
+
+
+def test_main_writes_csv_and_png(tmp_path):
+    for name, base in (("r1", 3.0), ("r2", 4.0)):
+        _write_metrics(tmp_path / name, [
+            {"kind": "validation", "step": s, "cost": base - s / 1000,
+             "accuracy": s / 1000}
+            for s in (100, 200, 300)
+        ])
+    out = tmp_path / "plots" / "curves"
+    plot.main([str(tmp_path / "r1"), str(tmp_path / "r2"), "--out", str(out)])
+    csv_lines = (out.parent / "curves.csv").read_text().splitlines()
+    assert csv_lines[0] == "run,step,validation_cost,validation_accuracy"
+    assert len(csv_lines) == 7  # header + 2 runs x 3 points
+    assert csv_lines[1].startswith("r1,100,")
+    try:
+        import matplotlib  # noqa: F401
+    except ImportError:
+        return
+    assert (out.parent / "curves.png").exists()
