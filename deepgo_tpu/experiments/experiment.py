@@ -44,6 +44,10 @@ class ExperimentConfig:
     # model (reference basicGoExperiment defaults, experiments.lua:33-46)
     num_layers: int = 3
     channels: int = 64
+    # per-layer widths, e.g. "128,128,64" (len = num_layers - 1); overrides
+    # ``channels`` when set (the reference's per-layer channel list,
+    # experiments.lua:88-93)
+    channel_schedule: str = ""
     first_kernel: int = 5
     kernel: int = 3
     final_relu: bool = False
@@ -77,9 +81,14 @@ class ExperimentConfig:
     profile: bool = False  # capture a jax.profiler trace of train() into the run dir
 
     def model_config(self) -> policy_cnn.ModelConfig:
+        channels = self.channels
+        if self.channel_schedule:
+            channels = tuple(
+                int(c) for c in self.channel_schedule.split(",") if c.strip()
+            )
         return policy_cnn.ModelConfig(
             num_layers=self.num_layers,
-            channels=self.channels,
+            channels=channels,
             first_kernel=self.first_kernel,
             kernel=self.kernel,
             final_relu=self.final_relu,
@@ -184,6 +193,13 @@ class Experiment:
         ewma = None
         last_val: dict = {}
         pending: list = []  # device-resident losses, fetched per print window
+
+        def fold_pending(ewma):
+            # EWMA 0.95/0.05, matching the reference (train.lua:115)
+            for value in map(float, pending):
+                ewma = value if ewma is None else 0.95 * ewma + 0.05 * value
+            pending.clear()
+            return ewma
         window_t0 = total_t0 = time.time()
         with AsyncLoader(
             train_set,
@@ -214,10 +230,8 @@ class Experiment:
                 # loop on the host<->device round-trip
                 pending.append(loss)
                 if self.step % cfg.print_interval == 0:
-                    for value in map(float, pending):
-                        ewma = value if ewma is None else 0.95 * ewma + 0.05 * value
                     loss = float(pending[-1])
-                    pending.clear()
+                    ewma = fold_pending(ewma)
                     window_dt = time.time() - window_t0
                     window_t0 = time.time()
                     sps = cfg.print_interval * cfg.batch_size / window_dt
@@ -233,6 +247,9 @@ class Experiment:
                     else:
                         print(f"training {ewma:.4f} (samples per second {sps:.0f})")
 
+        # fold losses from a final partial print window into the EWMA so
+        # runs shorter than print_interval still report one
+        ewma = fold_pending(ewma)
         total_dt = time.time() - total_t0
         total_sps = cfg.batch_size * iters / total_dt
         print(f"total samples per second {total_sps:.0f}")

@@ -32,10 +32,15 @@ from ..features import NUM_PLANES
 @dataclass(frozen=True)
 class ModelConfig:
     """num_layers counts every convolution including the final 1-channel one,
-    matching the reference's numLayers (experiments.lua:39,88-94)."""
+    matching the reference's numLayers (experiments.lua:39,88-94).
+
+    ``channels`` is either one width for every hidden conv or a per-layer
+    tuple of num_layers - 1 widths — the reference's per-layer channel list
+    (its layer expansion appends the final 1-channel conv to the config's
+    ``channels`` table, experiments.lua:88-93)."""
 
     num_layers: int = 3
-    channels: int = 64
+    channels: int | tuple[int, ...] = 64
     first_kernel: int = 5
     kernel: int = 3
     input_planes: int = NUM_PLANES
@@ -46,13 +51,24 @@ class ModelConfig:
     # train the "large" config at big batch sizes within one chip's HBM
     remat: bool = False
 
+    def hidden_channels(self) -> tuple[int, ...]:
+        """Per-hidden-layer output widths (everything but the final conv)."""
+        if isinstance(self.channels, int):
+            return (self.channels,) * (self.num_layers - 1)
+        if len(self.channels) != self.num_layers - 1:
+            raise ValueError(
+                f"channels tuple has {len(self.channels)} entries; "
+                f"num_layers={self.num_layers} needs {self.num_layers - 1}"
+            )
+        return tuple(self.channels)
+
     def layer_shapes(self):
         """[(kernel, c_in, c_out)] for each conv layer."""
+        widths = self.hidden_channels() + (1,)
         shapes = []
         c_in = self.input_planes
-        for i in range(self.num_layers):
+        for i, c_out in enumerate(widths):
             k = self.first_kernel if i == 0 else self.kernel
-            c_out = 1 if i == self.num_layers - 1 else self.channels
             shapes.append((k, c_in, c_out))
             c_in = c_out
         return shapes

@@ -58,6 +58,31 @@ def test_model_shapes_and_param_count():
     assert logits.shape == (2, 361) and logits.dtype == jnp.float32
 
 
+def test_per_layer_channel_schedule():
+    # the reference's per-layer channel list (experiments.lua:88-93)
+    cfg = ModelConfig(num_layers=4, channels=(32, 16, 8))
+    params = init(jax.random.key(0), cfg)
+    assert [layer["w"].shape for layer in params["layers"]] == [
+        (5, 5, 37, 32), (3, 3, 32, 16), (3, 3, 16, 8), (3, 3, 8, 1)
+    ]
+    planes = jnp.zeros((2, 19, 19, 37), jnp.float32)
+    assert apply(params, planes, cfg).shape == (2, 361)
+
+    with pytest.raises(ValueError):
+        ModelConfig(num_layers=3, channels=(32, 16, 8)).layer_shapes()
+
+
+def test_channel_schedule_from_experiment_config():
+    from deepgo_tpu.experiments import ExperimentConfig
+
+    config = ExperimentConfig(num_layers=4, channel_schedule="32,16,8")
+    cfg = config.model_config()
+    assert cfg.channels == (32, 16, 8)
+    # round-trips through the checkpointed config dict
+    again = ExperimentConfig.from_dict(config.to_dict())
+    assert again.model_config().channels == (32, 16, 8)
+
+
 def test_log_policy_normalized():
     cfg = ModelConfig(num_layers=3, channels=16)
     params = init(jax.random.key(1), cfg)

@@ -25,21 +25,29 @@ def test_load_curves_filters_validation_rows(tmp_path):
     assert curves == {"abc123": [(100, 3.5, 0.1), (200, 3.1, 0.2)]}
 
 
-def test_main_writes_csv_and_png(tmp_path):
+def _two_runs(tmp_path):
     for name, base in (("r1", 3.0), ("r2", 4.0)):
         _write_metrics(tmp_path / name, [
             {"kind": "validation", "step": s, "cost": base - s / 1000,
              "accuracy": s / 1000}
             for s in (100, 200, 300)
         ])
+    return [str(tmp_path / "r1"), str(tmp_path / "r2")]
+
+
+def test_main_writes_csv(tmp_path):
     out = tmp_path / "plots" / "curves"
-    plot.main([str(tmp_path / "r1"), str(tmp_path / "r2"), "--out", str(out)])
+    plot.main(_two_runs(tmp_path) + ["--out", str(out)])
     csv_lines = (out.parent / "curves.csv").read_text().splitlines()
     assert csv_lines[0] == "run,step,validation_cost,validation_accuracy"
     assert len(csv_lines) == 7  # header + 2 runs x 3 points
     assert csv_lines[1].startswith("r1,100,")
-    try:
-        import matplotlib  # noqa: F401
-    except ImportError:
-        return
+
+
+def test_main_writes_png(tmp_path):
+    import pytest
+
+    pytest.importorskip("matplotlib")
+    out = tmp_path / "plots" / "curves"
+    plot.main(_two_runs(tmp_path) + ["--out", str(out)])
     assert (out.parent / "curves.png").exists()
