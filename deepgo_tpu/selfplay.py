@@ -234,11 +234,17 @@ def main(argv=None) -> None:
            for k, v in stats.items()})
 
     if args.sgf_out:
+        from .go.scoring import area_score
+
         os.makedirs(args.sgf_out, exist_ok=True)
         for i, g in enumerate(games):
+            # only finished games (double pass) get a result: Tromp-Taylor
+            # on a move-cap-truncated board would be arbitrary
+            s = area_score(g.stones) if g.passes >= 2 else None
             with open(os.path.join(args.sgf_out, f"game_{i:04d}.sgf"), "w") as f:
-                f.write(to_sgf(g))
-        print(f"wrote {len(games)} SGFs to {args.sgf_out}")
+                f.write(to_sgf(g, result=s and s.result_string(),
+                               komi=s and s.komi))
+        print(f"wrote {len(games)} scored SGFs to {args.sgf_out}")
 
 
 if __name__ == "__main__":
