@@ -19,6 +19,7 @@ Prints exactly one JSON line:
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -26,7 +27,41 @@ import numpy as np
 BASELINE_BOARDS_PER_SEC = 10_000.0
 
 
+def _arm_watchdog():
+    """Fail loudly if the device never answers.
+
+    When the TPU relay wedges, the PJRT claim retries forever inside a C
+    call, hanging the process silently (a SIGALRM handler never runs —
+    the main thread never returns to the interpreter). A daemon timer
+    thread prints a diagnostic JSON line and hard-exits instead. A healthy
+    TPU run finishes well under the default 900s (compile ~40s,
+    measurement ~4s). Disable with BENCH_WATCHDOG=0; cancel() on success.
+    """
+    import threading
+
+    if os.environ.get("BENCH_WATCHDOG") == "0":
+        return None
+
+    def on_timeout():
+        print(json.dumps({
+            "metric": "policy_inference_boards_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "boards/sec",
+            "vs_baseline": 0.0,
+            "error": "device unreachable: watchdog fired before any result "
+                     "(TPU relay claim likely wedged)",
+        }), flush=True)
+        os._exit(1)
+
+    timer = threading.Timer(float(os.environ.get("BENCH_WATCHDOG_S", "900")),
+                            on_timeout)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def main() -> None:
+    watchdog = _arm_watchdog()
     import jax
     import jax.numpy as jnp
 
@@ -73,6 +108,8 @@ def main() -> None:
     dt = float(np.median(times))
     boards_per_sec = k_batches * batch / dt
 
+    if watchdog is not None:
+        watchdog.cancel()
     print(json.dumps({
         "metric": "policy_inference_boards_per_sec_per_chip",
         "value": round(boards_per_sec, 1),

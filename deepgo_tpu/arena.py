@@ -179,7 +179,7 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
     return games, scores, stats
 
 
-def _make_agent(spec: str, seed: int) -> Agent:
+def _make_agent(spec: str, seed: int, temperature: float = 0.0) -> Agent:
     if spec == "random":
         return RandomAgent()
     if spec == "heuristic":
@@ -188,11 +188,12 @@ def _make_agent(spec: str, seed: int) -> Agent:
         from .models.serving import load_policy
 
         _, params, cfg = load_policy(spec.split(":", 1)[1])
-        return PolicyAgent(params, cfg, name="policy")
+        return PolicyAgent(params, cfg, name="policy", temperature=temperature)
     if spec.startswith("model:"):  # random-init policy, for smoke runs
         cfg = policy_cnn.CONFIGS[spec.split(":", 1)[1]]
         params = policy_cnn.init(jax.random.key(seed), cfg)
-        return PolicyAgent(params, cfg, name=f"init-{spec.split(':', 1)[1]}")
+        return PolicyAgent(params, cfg, name=f"init-{spec.split(':', 1)[1]}",
+                           temperature=temperature)
     raise ValueError(f"unknown agent spec {spec!r} "
                      "(use random | heuristic | checkpoint:PATH | model:NAME)")
 
@@ -207,11 +208,14 @@ def main(argv=None) -> None:
     ap.add_argument("--komi", type=float, default=7.5)
     ap.add_argument("--max-moves", type=int, default=450)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="softmax sampling temperature for policy agents "
+                         "(0 = argmax; >0 diversifies policy-vs-policy games)")
     ap.add_argument("--sgf-out", help="directory to write scored games")
     args = ap.parse_args(argv)
 
-    agent_a = _make_agent(args.a, args.seed)
-    agent_b = _make_agent(args.b, args.seed + 1)
+    agent_a = _make_agent(args.a, args.seed, args.temperature)
+    agent_b = _make_agent(args.b, args.seed + 1, args.temperature)
     games, scores, stats = play_match(agent_a, agent_b, n_games=args.games,
                                       komi=args.komi, max_moves=args.max_moves,
                                       seed=args.seed)
@@ -220,10 +224,18 @@ def main(argv=None) -> None:
 
     if args.sgf_out:
         os.makedirs(args.sgf_out, exist_ok=True)
+        finished = 0
         for i, (g, s) in enumerate(zip(games, scores)):
+            # RE[] only for games that ended on double pass; a move-cap
+            # truncation is scored for the stats table (standard
+            # approximation) but not stamped into the record
+            done = g.passes >= 2
+            finished += done
             with open(os.path.join(args.sgf_out, f"match_{i:04d}.sgf"), "w") as f:
-                f.write(to_sgf(g, result=s.result_string(), komi=args.komi))
-        print(f"wrote {len(games)} scored SGFs to {args.sgf_out}")
+                f.write(to_sgf(g, result=s.result_string() if done else None,
+                               komi=args.komi))
+        print(f"wrote {len(games)} SGFs ({finished} finished/scored, "
+              f"{len(games) - finished} move-cap truncated) to {args.sgf_out}")
 
 
 if __name__ == "__main__":

@@ -237,14 +237,17 @@ def main(argv=None) -> None:
         from .go.scoring import area_score
 
         os.makedirs(args.sgf_out, exist_ok=True)
+        scored = 0
         for i, g in enumerate(games):
             # only finished games (double pass) get a result: Tromp-Taylor
             # on a move-cap-truncated board would be arbitrary
             s = area_score(g.stones) if g.passes >= 2 else None
+            scored += s is not None
             with open(os.path.join(args.sgf_out, f"game_{i:04d}.sgf"), "w") as f:
                 f.write(to_sgf(g, result=s and s.result_string(),
                                komi=s and s.komi))
-        print(f"wrote {len(games)} scored SGFs to {args.sgf_out}")
+        print(f"wrote {len(games)} SGFs ({scored} finished/scored) "
+              f"to {args.sgf_out}")
 
 
 if __name__ == "__main__":

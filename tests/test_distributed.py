@@ -28,8 +28,13 @@ def test_hybrid_mesh_spans_all_devices():
     assert ids == sorted(ids)
 
 
-def test_per_host_batch_divides_evenly():
-    assert distributed.per_host_batch(256) == 256 // jax.process_count()
+def test_per_host_batch_divides_evenly(monkeypatch):
+    import pytest
+
+    monkeypatch.setattr(distributed.jax, "process_count", lambda: 4)
+    assert distributed.per_host_batch(256) == 64
+    with pytest.raises(AssertionError):
+        distributed.per_host_batch(254)  # not divisible by 4 processes
 
 
 def test_global_array_from_local_roundtrip():
