@@ -1,0 +1,46 @@
+"""Driver contract for bench.py: exactly one JSON line, required keys."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import REPO_ROOT
+
+
+def _run_bench(extra_env, timeout):
+    # pin BENCH_WATCHDOG so an ambient =0 can't disable the tested mechanism
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
+               BENCH_WATCHDOG="1", **extra_env)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
+def test_watchdog_emits_contract_json_and_fails():
+    # a 1s budget guarantees the timer beats any CPU bench; the emitted
+    # line must still satisfy the driver's schema
+    proc = _run_bench({"BENCH_WATCHDOG_S": "1"}, timeout=120)
+    assert proc.returncode == 1
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    record = json.loads(lines[0])
+    assert record["metric"] == "policy_inference_boards_per_sec_per_chip"
+    assert record["value"] == 0.0 and record["vs_baseline"] == 0.0
+    assert "unreachable" in record["error"]
+
+
+@pytest.mark.skipif(not os.environ.get("DEEPGO_BENCH_FULL"),
+                    reason="set DEEPGO_BENCH_FULL=1 for the ~2min CPU bench")
+def test_cpu_bench_contract():
+    proc = _run_bench({}, timeout=600)
+    assert proc.returncode == 0
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    record = json.loads(lines[0])
+    assert record["metric"] == "policy_inference_boards_per_sec_per_chip"
+    assert record["value"] > 0
+    assert set(record) >= {"metric", "value", "unit", "vs_baseline"}
