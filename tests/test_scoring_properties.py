@@ -1,0 +1,73 @@
+"""Property tests for Tromp-Taylor scoring against a brute-force oracle."""
+
+import numpy as np
+
+from deepgo_tpu.go import BLACK, EMPTY, WHITE
+from deepgo_tpu.go.board import SIZE, neighbors
+from deepgo_tpu.go.scoring import area_score
+
+
+def brute_force_score(stones):
+    """Independent implementation: per empty point, BFS the reachable
+    colors; the point scores for a color iff only that color is reachable."""
+    black = int((stones == BLACK).sum())
+    white = int((stones == WHITE).sum())
+    for x in range(SIZE):
+        for y in range(SIZE):
+            if stones[x, y] != EMPTY:
+                continue
+            seen = {(x, y)}
+            stack = [(x, y)]
+            colors = set()
+            while stack:
+                p = stack.pop()
+                for n in neighbors(*p):
+                    v = stones[n]
+                    if v == EMPTY:
+                        if n not in seen:
+                            seen.add(n)
+                            stack.append(n)
+                    else:
+                        colors.add(int(v))
+            if colors == {BLACK}:
+                black += 1
+            elif colors == {WHITE}:
+                white += 1
+    return black, white
+
+
+def random_board(rng, fill):
+    return rng.choice(
+        np.array([EMPTY, BLACK, WHITE], dtype=np.uint8),
+        size=(SIZE, SIZE),
+        p=[1 - fill, fill / 2, fill / 2],
+    )
+
+
+def test_matches_brute_force_on_random_boards():
+    rng = np.random.default_rng(0)
+    for fill in (0.0, 0.05, 0.3, 0.7, 0.95):
+        for _ in range(8):
+            stones = random_board(rng, fill)
+            s = area_score(stones, komi=0.0)
+            assert (s.black, s.white) == brute_force_score(stones), (
+                f"mismatch at fill={fill}"
+            )
+
+
+def test_color_swap_symmetry():
+    rng = np.random.default_rng(1)
+    for _ in range(10):
+        stones = random_board(rng, 0.4)
+        swapped = stones.copy()
+        swapped[stones == BLACK] = WHITE
+        swapped[stones == WHITE] = BLACK
+        s, t = area_score(stones, komi=0.0), area_score(swapped, komi=0.0)
+        assert (s.black, s.white) == (t.white, t.black)
+
+
+def test_totals_bounded_by_board():
+    rng = np.random.default_rng(2)
+    for _ in range(10):
+        s = area_score(random_board(rng, 0.5), komi=0.0)
+        assert 0 <= s.black + s.white <= SIZE * SIZE
