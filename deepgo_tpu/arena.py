@@ -47,10 +47,32 @@ class Agent:
         raise NotImplementedError
 
 
+def _no_own_eyes(packed, players, legal):
+    """Mask single-point own eyes (all 4 neighbors own stones) from legal.
+
+    Without this, stone-placing baselines fill their own territory forever
+    and every game truncates at the move cap; with it they run out of
+    sensible moves, pass, and games end properly for scoring (the standard
+    naive-rollout eye rule; diagonals deliberately ignored).
+    """
+    from .features import P_STONES
+
+    n = len(packed)
+    stones = packed[:, P_STONES].astype(np.int8)
+    own = stones == players[:, None, None]
+    # a padded neighbor counts as "own" so edge/corner eyes are masked too
+    padded = np.ones((n, 21, 21), dtype=bool)
+    padded[:, 1:20, 1:20] = own
+    eye = (padded[:, :19, 1:20] & padded[:, 2:, 1:20]
+           & padded[:, 1:20, :19] & padded[:, 1:20, 2:])
+    return legal & ~eye.reshape(n, -1)
+
+
 class RandomAgent(Agent):
     name = "random"
 
     def select_moves(self, packed, players, legal, rng):
+        legal = _no_own_eyes(packed, players, legal)
         moves = np.full(len(packed), -1, dtype=np.int64)
         for i in range(len(packed)):
             choices = np.flatnonzero(legal[i])
@@ -65,6 +87,7 @@ class HeuristicAgent(Agent):
     name = "heuristic"
 
     def select_moves(self, packed, players, legal, rng):
+        legal = _no_own_eyes(packed, players, legal)
         n = len(packed)
         idx = np.arange(n)
         kills = packed[idx, P_KILLS + players - 1].reshape(n, -1).astype(np.int64)

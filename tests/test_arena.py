@@ -128,6 +128,25 @@ class TestArena:
         parsed = sgf.parse(text)
         assert len(parsed.moves) == len(games[0].moves)
 
+    def test_no_own_eyes_mask(self):
+        from deepgo_tpu.selfplay import legal_mask, summarize_state
+
+        g = arena.GameState()
+        # black corner eye at (0,0); white center eye at (10,10)
+        for x, y in [(0, 1), (1, 0)]:
+            play(g.stones, g.age, x, y, BLACK)
+        for x, y in [(9, 10), (11, 10), (10, 9), (10, 11)]:
+            play(g.stones, g.age, x, y, WHITE)
+        packed = np.stack([summarize_state(g)] * 2)
+        players = np.array([1, 2], dtype=np.int32)
+        legal = legal_mask(packed, players)
+        masked = arena._no_own_eyes(packed, players, legal)
+        assert legal[0, 0] and not masked[0, 0]        # black's own eye
+        assert masked[1, 0]                            # not white's eye
+        center = 19 * 10 + 10
+        assert legal[1, center] and not masked[1, center]  # white's own eye
+        assert masked[0, center]                       # black may invade it
+
     def test_simple_ko_ban(self):
         from deepgo_tpu.selfplay import apply_move, legal_mask, summarize_state
 
