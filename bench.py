@@ -27,40 +27,73 @@ import numpy as np
 BASELINE_BOARDS_PER_SEC = 10_000.0
 
 
+def _diagnostic_json(error: str) -> str:
+    return json.dumps({
+        "metric": "policy_inference_boards_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "boards/sec",
+        "vs_baseline": 0.0,
+        "error": error,
+    })
+
+
 def _arm_watchdog():
     """Fail loudly if the device never answers.
 
-    When the TPU relay wedges, the PJRT claim retries forever inside a C
-    call, hanging the process silently (a SIGALRM handler never runs —
-    the main thread never returns to the interpreter). A daemon timer
-    thread prints a diagnostic JSON line and hard-exits instead. A healthy
-    TPU run finishes well under the default 900s (compile ~40s,
-    measurement ~4s). Disable with BENCH_WATCHDOG=0; cancel() on success.
+    A wedged relay claim blocks in C code while holding the GIL, so an
+    in-process timer thread (round 1's design) can never fire. The shared
+    external-process watchdog (deepgo_tpu/utils/watchdog.py) SIGKILLs this
+    process instead, after printing the one-line JSON diagnostic the driver
+    expects. A healthy TPU run finishes well under the default 900s
+    (compile ~40s, measurement ~4s). Disable with BENCH_WATCHDOG=0;
+    disarm() on success.
     """
-    import threading
+    from deepgo_tpu.utils import watchdog
 
     if os.environ.get("BENCH_WATCHDOG") == "0":
-        return None
+        return watchdog.Watchdog(None)
+    return watchdog.arm(
+        "bench", float(os.environ.get("BENCH_WATCHDOG_S", "900")),
+        diagnostic_json=_diagnostic_json(
+            "device unreachable: watchdog fired before any result "
+            "(TPU relay claim likely wedged)"),
+    )
 
-    def on_timeout():
-        print(json.dumps({
-            "metric": "policy_inference_boards_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "boards/sec",
-            "vs_baseline": 0.0,
-            "error": "device unreachable: watchdog fired before any result "
-                     "(TPU relay claim likely wedged)",
-        }), flush=True)
-        os._exit(1)
 
-    timer = threading.Timer(float(os.environ.get("BENCH_WATCHDOG_S", "900")),
-                            on_timeout)
-    timer.daemon = True
-    timer.start()
-    return timer
+def _preflight_probe() -> None:
+    """Claim-and-release the device in a child with a short timeout.
+
+    A wedged relay then fails the bench in seconds (with a parseable JSON
+    line), not at the 900s watchdog / driver timeout. The child inherits
+    the full environment (including the relay sitecustomize) so it probes
+    exactly the backend the benchmark will use; it exits immediately after
+    the claim, releasing the single-tenant grant before the main process
+    claims. Disable with BENCH_PREFLIGHT=0.
+    """
+    import subprocess
+    import sys
+
+    if os.environ.get("BENCH_PREFLIGHT") == "0":
+        return
+    timeout_s = float(os.environ.get("BENCH_PREFLIGHT_S", "60"))
+    code = "import jax; print(jax.devices()[0].platform, flush=True)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(_diagnostic_json(
+            f"pre-flight device probe timed out after {timeout_s}s "
+            "(TPU relay claim likely wedged)"), flush=True)
+        raise SystemExit(1)
+    if r.returncode != 0:
+        print(_diagnostic_json(
+            "pre-flight device probe failed: " + r.stderr[-400:].strip()),
+            flush=True)
+        raise SystemExit(1)
 
 
 def main() -> None:
+    _preflight_probe()
     watchdog = _arm_watchdog()
     import jax
     import jax.numpy as jnp
@@ -108,8 +141,7 @@ def main() -> None:
     dt = float(np.median(times))
     boards_per_sec = k_batches * batch / dt
 
-    if watchdog is not None:
-        watchdog.cancel()
+    watchdog.disarm()
     print(json.dumps({
         "metric": "policy_inference_boards_per_sec_per_chip",
         "value": round(boards_per_sec, 1),

@@ -1,0 +1,77 @@
+"""External-process watchdog for wedged device claims.
+
+A wedged TPU-relay claim blocks inside a C call while holding the GIL, so
+neither SIGALRM handlers nor in-process timer threads can run (round-1
+postmortem: bench watchdog thread never fired; driver recorded rc=124
+timeouts). The only robust watchdog is another *process*: a child — started
+with sitecustomize stripped from PYTHONPATH so it can never touch the relay
+itself — polls its parentage once a second; if the parent is still alive
+after ``timeout_s`` it emits a diagnostic (optionally a JSON line on stdout
+for machine consumers like the bench driver) and SIGKILLs it. Fast, loud
+failure instead of a silent multi-minute driver timeout.
+
+Capability anchor: the reference's only failure-detection mechanism is the
+``pcall`` bad-batch capture (reference ``train.lua:106-109``); a hang
+watchdog is the TPU-relay-era equivalent.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+class Watchdog:
+    """Handle for an armed watchdog child; ``disarm()`` on success."""
+
+    def __init__(self, proc: subprocess.Popen | None):
+        self._proc = proc
+
+    def disarm(self) -> None:
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                self._proc.kill()
+                self._proc.wait()
+            self._proc = None
+
+
+def arm(label: str, timeout_s: float = 120.0,
+        diagnostic_json: str | None = None) -> Watchdog:
+    """Arm an external watchdog that SIGKILLs this process after timeout_s.
+
+    The child exits on its own when this process finishes (reparenting
+    check), so even an un-disarmed watchdog cannot kill an innocent later
+    process. ``diagnostic_json``, if given, is printed verbatim to the
+    shared stdout right before the kill so line-oriented consumers still
+    get a parseable record. Disabling is the caller's job (each surface
+    owns its knob, e.g. BENCH_WATCHDOG / GRAFT_WATCHDOG): pass through to
+    ``Watchdog(None)`` there rather than arming.
+    """
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    lines = [
+        "import os, signal, sys, time",
+        f"ppid = {os.getpid()}",
+        f"label = {str(label)!r}",
+        f"for _ in range(max(1, int({float(timeout_s)!r}))):",
+        "    time.sleep(1)",
+        "    if os.getppid() != ppid:",
+        "        sys.exit(0)",
+    ]
+    if diagnostic_json is not None:
+        lines += [
+            f"sys.stdout.write({diagnostic_json + chr(10)!r})",
+            "sys.stdout.flush()",
+        ]
+    lines += [
+        "sys.stderr.write('watchdog: %s still blocked after "
+        f"{float(timeout_s)}s (device claim likely wedged); "
+        "killing %d\\n' % (label, ppid))",
+        "sys.stderr.flush()",
+        "os.kill(ppid, signal.SIGKILL)",
+    ]
+    proc = subprocess.Popen([sys.executable, "-c", "\n".join(lines)], env=env)
+    return Watchdog(proc)

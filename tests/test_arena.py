@@ -142,10 +142,14 @@ class TestArena:
         legal = legal_mask(packed, players)
         masked = arena._no_own_eyes(packed, players, legal)
         assert legal[0, 0] and not masked[0, 0]        # black's own eye
-        assert masked[1, 0]                            # not white's eye
+        # White playing inside black's one-point eye captures nothing and
+        # ends with zero liberties: suicide.  legal_mask must already
+        # exclude it, so the eye mask can never re-admit it.
+        assert not legal[1, 0] and not masked[1, 0]
         center = 19 * 10 + 10
         assert legal[1, center] and not masked[1, center]  # white's own eye
-        assert masked[0, center]                       # black may invade it
+        # Same for black invading white's one-point eye: suicide.
+        assert not legal[0, center] and not masked[0, center]
 
     def test_simple_ko_ban(self):
         from deepgo_tpu.selfplay import apply_move, legal_mask, summarize_state

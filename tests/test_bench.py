@@ -13,7 +13,8 @@ from tests.conftest import REPO_ROOT
 def _run_bench(extra_env, timeout):
     # pin BENCH_WATCHDOG so an ambient =0 can't disable the tested mechanism
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
-               BENCH_WATCHDOG="1", **extra_env)
+               BENCH_WATCHDOG="1", GRAFT_WATCHDOG="1")
+    env.update(extra_env)
     return subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
         capture_output=True, text=True, timeout=timeout, env=env,
@@ -21,16 +22,30 @@ def _run_bench(extra_env, timeout):
 
 
 def test_watchdog_emits_contract_json_and_fails():
-    # a 1s budget guarantees the timer beats any CPU bench; the emitted
-    # line must still satisfy the driver's schema
+    # a 1s budget guarantees the external watchdog beats any CPU bench; the
+    # emitted line must still satisfy the driver's schema. The watchdog
+    # SIGKILLs from outside (robust to a GIL-held wedge), so rc is -SIGKILL.
     proc = _run_bench({"BENCH_WATCHDOG_S": "1"}, timeout=120)
-    assert proc.returncode == 1
+    assert proc.returncode != 0
     lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
     assert len(lines) == 1
     record = json.loads(lines[0])
     assert record["metric"] == "policy_inference_boards_per_sec_per_chip"
     assert record["value"] == 0.0 and record["vs_baseline"] == 0.0
     assert "unreachable" in record["error"]
+
+
+def test_preflight_probe_fails_fast_on_unreachable_device():
+    # A bogus platform makes the probe child die quickly; bench must emit
+    # one schema-compliant JSON line and exit 1 without ever arming the
+    # 900s path.
+    proc = _run_bench({"JAX_PLATFORMS": "no_such_platform"}, timeout=120)
+    assert proc.returncode == 1
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    record = json.loads(lines[0])
+    assert record["value"] == 0.0
+    assert "pre-flight" in record["error"]
 
 
 @pytest.mark.skipif(not os.environ.get("DEEPGO_BENCH_FULL"),
