@@ -97,6 +97,42 @@ class GoDataset:
         """Deterministic prefix batch (fixed validation sets)."""
         return self.batch_at(np.arange(min(n, len(self))))
 
+    def even_indices(self, n: int) -> np.ndarray:
+        """Deterministic sample of n positions spread evenly across games.
+
+        Waterfill: every game contributes equally until its moves run out,
+        so the sample covers min(num_games, n) games; within a game the
+        quota is evenly spaced over the move sequence. No randomness — the
+        same split always yields the same set. This replaces the round-1
+        file-prefix validation set, which was biased to a handful of games
+        when ``n`` was small (and improves on the reference, which drew ONE
+        random minibatch per run, train.lua:62-67).
+        """
+        n = min(n, len(self))
+        counts = self.game_ranges[:, 1]
+        quota = np.zeros_like(counts)
+        remaining = n
+        while remaining > 0:
+            active = np.flatnonzero(quota < counts)
+            share = remaining // len(active)
+            if share == 0:
+                quota[active[:remaining]] += 1
+                break
+            add = np.minimum(counts[active] - quota[active], share)
+            quota[active] += add
+            remaining -= int(add.sum())
+        out = []
+        for g in np.flatnonzero(quota):
+            pos = np.round(
+                np.linspace(0, counts[g] - 1, quota[g])
+            ).astype(np.int64)
+            out.append(self.game_ranges[g, 0] + pos)
+        return np.concatenate(out) if out else np.zeros(0, np.int64)
+
+    def even_n(self, n: int):
+        """Deterministic, game-balanced batch (fixed validation sets)."""
+        return self.batch_at(self.even_indices(n))
+
 
 class DatasetWriter:
     """Streaming writer for one split: append games, then finalize."""

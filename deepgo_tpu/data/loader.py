@@ -50,16 +50,30 @@ class AsyncLoader:
         prefetch: int = 4,
         sharding=None,
         augment: bool = False,
+        stack: int = 0,
+        stack_sharding=None,
     ):
+        """``stack=K`` (K >= 1) makes ``get()`` return superbatches: K host
+        batches stacked to (K, B, ...) and transferred in one device_put,
+        for the scan-based multi-step train program
+        (training.make_train_step_many). ``stack_sharding`` places them
+        (parallel.superbatch_sharding); ``stack=0`` keeps the one-batch
+        behavior."""
         self.dataset = dataset
         self.batch_size = batch_size
         self.scheme = scheme
         self.sharding = sharding
         self.augment = augment
+        self.stack = stack
+        self.stack_sharding = stack_sharding
         self.num_threads = num_threads
         self._seq = np.random.SeedSequence(seed)
         if num_threads > 0:
-            self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+            # prefetch is in units of get() calls: scale the single-batch
+            # queue by the stack depth so a whole superbatch can be buffered
+            # while the device runs the previous K-step program
+            self._queue: queue.Queue = queue.Queue(
+                maxsize=prefetch * max(1, stack))
             self._stop = threading.Event()
             self._threads = [
                 threading.Thread(
@@ -85,15 +99,27 @@ class AsyncLoader:
                 except queue.Full:
                     continue
 
-    def get(self) -> dict:
-        """Next batch, already dispatched to device (async transfer)."""
+    def _host_batch(self) -> dict:
         if self.num_threads > 0:
-            batch = self._queue.get()
-        else:
-            batch = make_host_batch(self.dataset, self._rng, self.batch_size,
-                                    self.scheme, self.augment)
-        if self.sharding is not None:
-            return jax.device_put(batch, self.sharding)
+            return self._queue.get()
+        return make_host_batch(self.dataset, self._rng, self.batch_size,
+                               self.scheme, self.augment)
+
+    def get(self, stack: int | None = None) -> dict:
+        """Next (super)batch, already dispatched to device (async transfer).
+
+        ``stack`` overrides the constructor's stack depth for this call
+        (used for a final partial window when iters % K != 0)."""
+        stack = self.stack if stack is None else stack
+        if stack < 1:
+            batch = self._host_batch()
+            if self.sharding is not None:
+                return jax.device_put(batch, self.sharding)
+            return jax.device_put(batch)
+        parts = [self._host_batch() for _ in range(stack)]
+        batch = {k: np.stack([p[k] for p in parts]) for k in parts[0]}
+        if self.stack_sharding is not None:
+            return jax.device_put(batch, self.stack_sharding)
         return jax.device_put(batch)
 
     def __iter__(self):

@@ -1,3 +1,8 @@
 """Device meshes, shardings, and distributed helpers."""
 
-from .mesh import data_sharding, make_mesh, replicated_sharding  # noqa: F401
+from .mesh import (  # noqa: F401
+    data_sharding,
+    make_mesh,
+    replicated_sharding,
+    superbatch_sharding,
+)

@@ -41,5 +41,12 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("data"))
 
 
+def superbatch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for stacked (K, B, ...) superbatches: the steps dimension is
+    unsharded (lax.scan iterates it), the batch dimension splits over
+    "data" exactly like data_sharding."""
+    return NamedSharding(mesh, P(None, "data"))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

@@ -1,4 +1,8 @@
 """Training: optimizers, jitted train/eval steps, the training loop."""
 
 from .optimizers import adagrad, sgd  # noqa: F401
-from .steps import make_eval_step, make_train_step  # noqa: F401
+from .steps import (  # noqa: F401
+    make_eval_step,
+    make_train_step,
+    make_train_step_many,
+)

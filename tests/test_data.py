@@ -85,6 +85,41 @@ def test_game_scheme_uniform_over_games():
         assert ds.meta[idx][:, M_GAME].max() == 1
 
 
+def test_even_indices_balanced_deterministic(tmp_path):
+    """The fixed validation sampler must cover min(num_games, n) games,
+    spread within each game, never repeat a position, and be a pure
+    function of the split (round-1 verdict item 8)."""
+    d = str(tmp_path / "split")
+    writer = DatasetWriter(d)
+    counts = {"a": 3, "b": 50, "c": 120, "d": 7}
+    for name, m in counts.items():
+        packed = np.zeros((m, 9, 19, 19), np.uint8)
+        meta = np.zeros((m, 6), np.int32)
+        meta[:, 0] = 1
+        meta[:, 3:5] = 5
+        writer.add_game(name, packed, meta)
+    writer.finalize()
+    ds = GoDataset(os.path.dirname(d), os.path.basename(d))
+
+    idx = ds.even_indices(40)
+    assert len(idx) == 40
+    assert len(np.unique(idx)) == 40
+    games = ds.meta[idx][:, M_GAME]
+    per_game = np.bincount(games, minlength=4)
+    # all 4 games covered; the short game contributes everything it has,
+    # the rest share the remainder near-equally
+    assert (per_game > 0).all()
+    assert per_game[0] == 3
+    assert abs(per_game[1] - per_game[2]) <= 1
+    # deterministic
+    assert np.array_equal(idx, ds.even_indices(40))
+    # n >= len degenerates to every position exactly once, in order
+    assert np.array_equal(ds.even_indices(10_000), np.arange(len(ds)))
+    # tiny n still spreads across games (one position from n games)
+    tiny = ds.meta[ds.even_indices(3)][:, M_GAME]
+    assert len(np.unique(tiny)) == 3
+
+
 def test_transcribe_game_skips_unranked(tmp_path):
     p = tmp_path / "g.sgf"
     p.write_text("(;BR[5k]WR[1d];B[pd];W[dd])")
