@@ -102,6 +102,54 @@ class HeuristicAgent(Agent):
         return moves
 
 
+class OnePlyAgent(Agent):
+    """1-ply lookahead over every packed tactical channel.
+
+    Strictly stronger than HeuristicAgent (verified by head-to-head in
+    tests/RESULTS): for each legal point it weighs, from the to-move
+    player's perspective,
+      * stones captured by playing there (P_KILLS, own channel),
+      * stones SAVED by playing there — the opponent's capture count at the
+        same point (P_KILLS, opponent channel): occupying it denies the
+        capture,
+      * working ladder captures (P_LADDERS, own channel),
+      * own liberties after the move, with a self-atari penalty
+        (P_LIB_AFTER own channel <= 1), and
+      * denial of high-liberty points to the opponent (P_LIB_AFTER,
+        opponent channel).
+    This is exactly the evaluation a 1-ply search over the feature
+    extractor's hypothetical-play data supports (reference
+    count_kills_and_liberties, makedata.lua:304-327) without replaying
+    moves; the round-1 verdict asked for it as an informative third
+    baseline (GnuGo is unavailable: zero egress).
+    """
+
+    name = "oneply"
+
+    def select_moves(self, packed, players, legal, rng):
+        from .features import P_LADDERS
+
+        legal = _no_own_eyes(packed, players, legal)
+        n = len(packed)
+        idx = np.arange(n)
+        mine, theirs = players - 1, 2 - players
+        my_kills = packed[idx, P_KILLS + mine].reshape(n, -1).astype(np.int64)
+        opp_kills = packed[idx, P_KILLS + theirs].reshape(n, -1).astype(np.int64)
+        my_libs = packed[idx, P_LIB_AFTER + mine].reshape(n, -1).astype(np.int64)
+        opp_libs = packed[idx, P_LIB_AFTER + theirs].reshape(n, -1).astype(np.int64)
+        ladders = packed[idx, P_LADDERS + mine].reshape(n, -1).astype(np.int64)
+        score = (1000 * my_kills + 700 * opp_kills + 400 * ladders
+                 + 12 * my_libs + 6 * opp_libs
+                 - 900 * (my_libs <= 1))
+        score = np.where(legal, score, np.int64(np.iinfo(np.int64).min))
+        moves = np.full(n, -1, dtype=np.int64)
+        for i in range(n):
+            if legal[i].any():
+                best = score[i].max()
+                moves[i] = rng.choice(np.flatnonzero(score[i] == best))
+        return moves
+
+
 class PolicyAgent(Agent):
     """The trained CNN, one batched TPU forward per ply."""
 
@@ -187,8 +235,12 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
             b_wins += 1
     name_a = agent_a.name
     name_b = agent_b.name if agent_b.name != name_a else agent_b.name + "-b"
+    # area-scoring a move-cap-truncated board is an approximation; surface
+    # how much of the result rests on it so win-rate consumers can judge
+    truncated = sum(1 for g in games if g.passes < 2)
     stats = {
         "games": n_games,
+        "truncated": truncated,
         f"{name_a}_wins": a_wins,
         f"{name_b}_wins": b_wins,
         "draws": draws,
@@ -207,6 +259,8 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0) -> Agent:
         return RandomAgent()
     if spec == "heuristic":
         return HeuristicAgent()
+    if spec == "oneply":
+        return OnePlyAgent()
     if spec.startswith("checkpoint:"):
         from .models.serving import load_policy
 
@@ -217,8 +271,9 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0) -> Agent:
         params = policy_cnn.init(jax.random.key(seed), cfg)
         return PolicyAgent(params, cfg, name=f"init-{spec.split(':', 1)[1]}",
                            temperature=temperature)
-    raise ValueError(f"unknown agent spec {spec!r} "
-                     "(use random | heuristic | checkpoint:PATH | model:NAME)")
+    raise ValueError(
+        f"unknown agent spec {spec!r} "
+        "(use random | heuristic | oneply | checkpoint:PATH | model:NAME)")
 
 
 def main(argv=None) -> None:

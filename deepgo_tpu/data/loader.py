@@ -65,6 +65,14 @@ class AsyncLoader:
         self.sharding = sharding
         self.augment = augment
         self.stack = stack
+        if stack >= 1 and stack_sharding is None and sharding is not None:
+            # derive the superbatch placement from the single-batch one so a
+            # caller's requested sharding is never silently dropped
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if isinstance(sharding, NamedSharding):
+                stack_sharding = NamedSharding(sharding.mesh,
+                                               P(None, *sharding.spec))
         self.stack_sharding = stack_sharding
         self.num_threads = num_threads
         self._seq = np.random.SeedSequence(seed)

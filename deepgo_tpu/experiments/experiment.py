@@ -225,9 +225,9 @@ class Experiment:
 
         def fold_pending(ewma, last_loss):
             # EWMA 0.95/0.05, matching the reference (train.lua:115). One
-            # host fetch per superstep call, at window boundaries only.
+            # host fetch per call, at window boundaries only.
             for losses in pending:
-                for value in np.asarray(losses).tolist():
+                for value in np.atleast_1d(np.asarray(losses)).tolist():
                     ewma = value if ewma is None else 0.95 * ewma + 0.05 * value
                     last_loss = value
             pending.clear()
@@ -246,6 +246,7 @@ class Experiment:
             augment=cfg.augment,
         ) as loader:
             remaining = iters
+            window_steps = 0
             while remaining > 0:
                 # realign to print-window boundaries first: a resume can
                 # start at a step that is not a multiple of print_interval,
@@ -253,30 +254,44 @@ class Experiment:
                 # one (no prints, no validation, no periodic checkpoints)
                 align = (-self.step) % cfg.print_interval
                 k = min(k_steps, remaining, align or k_steps)
-                batch = loader.get(stack=k)
+                batch = loader.get(stack=k if k == k_steps else 0)
                 try:
-                    self.params, self.opt_state, losses = step_many(
-                        self.params, self.opt_state, batch
-                    )
+                    if k == k_steps:
+                        self.params, self.opt_state, losses = step_many(
+                            self.params, self.opt_state, batch
+                        )
+                        pending.append(losses)
+                    else:
+                        # alignment / tail remainders run through the
+                        # single-step program (already compiled) instead of
+                        # paying a throwaway XLA compile of a k-step scan
+                        for j in range(k):
+                            self.params, self.opt_state, loss = self.train_step(
+                                self.params, self.opt_state, batch
+                            )
+                            pending.append(loss)
+                            if j < k - 1:
+                                batch = loader.get(stack=0)
                 except Exception:
-                    # postmortem capture: stash the failing superbatch for
-                    # offline debugging (reference train.lua:106-109 kept it
-                    # in globals; a file survives the process). Arrays carry
-                    # the leading (k, B) step dimension.
+                    # postmortem capture: stash the failing batch for offline
+                    # debugging (reference train.lua:106-109 kept it in
+                    # globals; a file survives the process). Full-window
+                    # superbatches carry the leading (K, B) step dimension.
                     bad = {k_: np.asarray(v) for k_, v in batch.items()}
                     np.savez(os.path.join(self.run_path, "bad_batch.npz"), **bad)
                     raise
                 self.step += k
                 remaining -= k
+                window_steps += k
                 # losses stay on device between prints so calls dispatch
                 # asynchronously; fetching every call would serialize the
                 # loop on the host<->device round-trip
-                pending.append(losses)
                 if self.step % cfg.print_interval == 0:
                     ewma, last_loss = fold_pending(ewma, last_loss)
                     window_dt = time.time() - window_t0
                     window_t0 = time.time()
-                    sps = cfg.print_interval * cfg.batch_size / window_dt
+                    sps = window_steps * cfg.batch_size / window_dt
+                    window_steps = 0
                     metrics.write("train", step=self.step, loss=last_loss,
                                   ewma=ewma, samples_per_sec=sps)
                     if self.step % cfg.validation_interval == 0:

@@ -1,0 +1,71 @@
+"""Child process for the real 2-process distributed test.
+
+Each of two processes runs this with (process_id, coordinator_port): joins
+the jax.distributed runtime over 2 virtual CPU devices per process (the
+multi-host analogue of the 8-virtual-device single-process tests), builds
+the hybrid data mesh spanning both processes, contributes its own half of
+a global batch via ``global_array_from_local``, and executes one
+data-parallel train step whose gradient all-reduce crosses the process
+boundary. Prints one line the parent asserts on.
+
+Usage: python distributed_child.py <process_id> <port>
+"""
+
+import os
+import sys
+
+PROC_ID = int(sys.argv[1])
+PORT = sys.argv[2]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{PORT}",
+    num_processes=2,
+    process_id=PROC_ID,
+)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepgo_tpu.models import ModelConfig, init  # noqa: E402
+from deepgo_tpu.parallel import distributed, replicated_sharding  # noqa: E402
+from deepgo_tpu.training import make_train_step, sgd  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 2
+assert jax.device_count() == 4
+
+mesh = distributed.hybrid_mesh(n_model=1)
+assert mesh.devices.shape == (4, 1)
+
+global_batch = 8
+local_n = distributed.per_host_batch(global_batch)
+assert local_n == 4
+
+# identical rng on both processes; each contributes its own slice, so the
+# assembled global batch equals the single-process batch for these seeds
+rng = np.random.default_rng(0)
+full = {
+    "packed": rng.integers(0, 3, size=(global_batch, 9, 19, 19), dtype=np.uint8),
+    "player": rng.integers(1, 3, size=global_batch).astype(np.int32),
+    "rank": rng.integers(1, 10, size=global_batch).astype(np.int32),
+    "target": rng.integers(0, 361, size=global_batch).astype(np.int32),
+}
+local = {k: v[PROC_ID * local_n:(PROC_ID + 1) * local_n] for k, v in full.items()}
+batch = distributed.global_array_from_local(mesh, local)
+
+cfg = ModelConfig(num_layers=2, channels=8, compute_dtype="float32")
+optimizer = sgd(0.01)
+params = jax.device_put(init(jax.random.key(0), cfg), replicated_sharding(mesh))
+opt_state = jax.device_put(optimizer.init(params), replicated_sharding(mesh))
+step = make_train_step(cfg, optimizer)
+
+params, opt_state, loss = step(params, opt_state, batch)
+jax.block_until_ready(loss)
+print(f"DIST_OK proc={PROC_ID} loss={float(loss):.6f}", flush=True)

@@ -128,6 +128,33 @@ class TestArena:
         parsed = sgf.parse(text)
         assert len(parsed.moves) == len(games[0].moves)
 
+    def test_oneply_beats_random_and_reports_truncation(self):
+        games, scores, stats = arena.play_match(
+            arena.OnePlyAgent(), arena.RandomAgent(), n_games=8,
+            max_moves=350, seed=11)
+        assert stats["oneply_win_rate"] >= 0.9
+        # truncation accounting: every game is either double-pass finished
+        # or counted truncated
+        finished = sum(1 for g in games if g.passes >= 2)
+        assert stats["truncated"] == len(games) - finished
+
+    def test_oneply_takes_capture(self):
+        from deepgo_tpu.selfplay import legal_mask, summarize_state
+
+        g = arena.GameState()
+        # white stone at (0,0) in atari: black (0,1),(1,0) capture at... the
+        # white group's last liberty is its own point? Build: white (0,0),
+        # black at (1,0); black to move at (0,1) captures.
+        play(g.stones, g.age, 0, 0, WHITE)
+        play(g.stones, g.age, 1, 0, BLACK)
+        g.player = 1
+        packed = summarize_state(g)[None]
+        players = np.array([1], dtype=np.int32)
+        legal = legal_mask(packed, players, [g])
+        rng = np.random.default_rng(0)
+        move = arena.OnePlyAgent().select_moves(packed, players, legal, rng)[0]
+        assert move == 0 * 19 + 1  # (0,1), the capturing point
+
     def test_no_own_eyes_mask(self):
         from deepgo_tpu.selfplay import legal_mask, summarize_state
 

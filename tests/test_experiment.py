@@ -174,13 +174,22 @@ def test_bad_batch_postmortem_capture(data_root, tmp_path):
     def exploding_step(params, opt_state, batch):
         raise FloatingPointError("synthetic step failure")
 
+    # full print windows go through the scan program; short tails through
+    # the single step — both must capture the batch they failed on
     exp.train_step_many = exploding_step
     with pytest.raises(FloatingPointError):
-        exp.run(5)
+        exp.run(10)
     dump = np.load(os.path.join(exp.run_path, "bad_batch.npz"))
-    # the superbatch carries a leading steps dimension (5 = min(K, iters))
-    assert dump["packed"].shape == (5, cfg.batch_size, 9, 19, 19)
+    assert dump["packed"].shape == (10, cfg.batch_size, 9, 19, 19)
     assert set(dump.files) >= {"packed", "player", "rank", "target"}
+
+    exp2 = Experiment(tiny_config(data_root, run_dir=str(tmp_path / "runs2")))
+    exp2.init()
+    exp2.train_step = exploding_step
+    with pytest.raises(FloatingPointError):
+        exp2.run(5)  # < print_interval -> single-step tail path
+    dump = np.load(os.path.join(exp2.run_path, "bad_batch.npz"))
+    assert dump["packed"].shape == (cfg.batch_size, 9, 19, 19)
 
 
 def test_evaluate_full_split(data_root, tmp_path):
