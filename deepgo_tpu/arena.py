@@ -105,9 +105,10 @@ class HeuristicAgent(Agent):
 class OnePlyAgent(Agent):
     """1-ply lookahead over every packed tactical channel.
 
-    Strictly stronger than HeuristicAgent (verified by head-to-head in
-    tests/RESULTS): for each legal point it weighs, from the to-move
-    player's perspective,
+    Stronger than HeuristicAgent (~63% head-to-head over 60 games; see the
+    RESULTS win-rate table, and tests/test_arena.py for the vs-random
+    floor): for each legal point it weighs, from the to-move player's
+    perspective,
       * stones captured by playing there (P_KILLS, own channel),
       * stones SAVED by playing there — the opponent's capture count at the
         same point (P_KILLS, opponent channel): occupying it denies the

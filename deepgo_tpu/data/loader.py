@@ -66,13 +66,17 @@ class AsyncLoader:
         self.augment = augment
         self.stack = stack
         if stack >= 1 and stack_sharding is None and sharding is not None:
-            # derive the superbatch placement from the single-batch one so a
-            # caller's requested sharding is never silently dropped
+            # derive the superbatch placement from a single-batch
+            # NamedSharding (P(spec) -> P(None, *spec)); other sharding
+            # types cannot be lifted generically, so refuse rather than
+            # silently drop the caller's placement
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            if isinstance(sharding, NamedSharding):
-                stack_sharding = NamedSharding(sharding.mesh,
-                                               P(None, *sharding.spec))
+            assert isinstance(sharding, NamedSharding), (
+                "stack >= 1 with a non-NamedSharding `sharding` requires an "
+                "explicit `stack_sharding`")
+            stack_sharding = NamedSharding(sharding.mesh,
+                                           P(None, *sharding.spec))
         self.stack_sharding = stack_sharding
         self.num_threads = num_threads
         self._seq = np.random.SeedSequence(seed)

@@ -254,35 +254,47 @@ class Experiment:
                 # one (no prints, no validation, no periodic checkpoints)
                 align = (-self.step) % cfg.print_interval
                 k = min(k_steps, remaining, align or k_steps)
-                batch = loader.get(stack=k if k == k_steps else 0)
-                try:
-                    if k == k_steps:
-                        self.params, self.opt_state, losses = step_many(
-                            self.params, self.opt_state, batch
-                        )
-                        pending.append(losses)
-                    else:
-                        # alignment / tail remainders run through the
-                        # single-step program (already compiled) instead of
-                        # paying a throwaway XLA compile of a k-step scan
-                        for j in range(k):
-                            self.params, self.opt_state, loss = self.train_step(
-                                self.params, self.opt_state, batch
-                            )
-                            pending.append(loss)
-                            if j < k - 1:
-                                batch = loader.get(stack=0)
-                except Exception:
+
+                def dump_bad(batch):
                     # postmortem capture: stash the failing batch for offline
                     # debugging (reference train.lua:106-109 kept it in
                     # globals; a file survives the process). Full-window
                     # superbatches carry the leading (K, B) step dimension.
                     bad = {k_: np.asarray(v) for k_, v in batch.items()}
                     np.savez(os.path.join(self.run_path, "bad_batch.npz"), **bad)
-                    raise
-                self.step += k
-                remaining -= k
-                window_steps += k
+
+                if k == k_steps:
+                    batch = loader.get()
+                    try:
+                        self.params, self.opt_state, losses = step_many(
+                            self.params, self.opt_state, batch
+                        )
+                    except Exception:
+                        dump_bad(batch)
+                        raise
+                    pending.append(losses)
+                    self.step += k
+                    remaining -= k
+                    window_steps += k
+                else:
+                    # alignment / tail remainders run through the
+                    # single-step program (already compiled) instead of
+                    # paying a throwaway XLA compile of a k-step scan;
+                    # per-step accounting keeps self.step consistent with
+                    # self.params if a mid-tail step fails
+                    for _ in range(k):
+                        batch = loader.get(stack=0)
+                        try:
+                            self.params, self.opt_state, loss = self.train_step(
+                                self.params, self.opt_state, batch
+                            )
+                        except Exception:
+                            dump_bad(batch)
+                            raise
+                        pending.append(loss)
+                        self.step += 1
+                        remaining -= 1
+                        window_steps += 1
                 # losses stay on device between prints so calls dispatch
                 # asynchronously; fetching every call would serialize the
                 # loop on the host<->device round-trip

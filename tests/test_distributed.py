@@ -68,15 +68,17 @@ def test_two_process_train_step():
         for i in range(2)
     ]
     outs = []
-    for p in procs:
-        try:
+    try:
+        for p in procs:
             out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+            assert p.returncode == 0, err[-3000:]
+            outs.append(out)
+    finally:
+        # a failing child must not orphan its peer blocked on the
+        # coordinator (it would tie up the port for jax's connect timeout)
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            raise
-        assert p.returncode == 0, err[-3000:]
-        outs.append(out)
     losses = []
     for out in outs:
         line = [l for l in out.splitlines() if l.startswith("DIST_OK")][0]

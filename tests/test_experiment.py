@@ -57,6 +57,22 @@ def test_async_loader_matches_sync_sampling(data_root):
         assert ((np.asarray(b["target"]) >= 0) & (np.asarray(b["target"]) < 361)).all()
 
 
+def test_loader_derives_stack_sharding(data_root):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from deepgo_tpu.parallel import data_sharding, make_mesh
+
+    ds = GoDataset(data_root, "validation")
+    mesh = make_mesh(len(jax.devices()), 1)
+    with AsyncLoader(ds, 8, num_threads=0, sharding=data_sharding(mesh),
+                     stack=3) as loader:
+        b = loader.get()
+    assert b["packed"].shape == (3, 8, 9, 19, 19)
+    # superbatch placement lifted from the single-batch spec
+    assert b["packed"].sharding.spec == P(None, "data")
+
+
 def test_train_smoke_loss_decreases(data_root, tmp_path):
     cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
     exp = Experiment(cfg)

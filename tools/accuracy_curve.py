@@ -1,0 +1,97 @@
+"""Accuracy-vs-corpus-size curve: same config, growing data, shared test set.
+
+Round-1 verdict item 4: demonstrate that the framework's accuracy axis is
+data-limited with evidence. For each position budget this trains the SAME
+model config for the SAME number of steps on a game-aligned subset of the
+corpus (tools/subset_split.py) and evaluates top-1 on the shared held-out
+test split; small subsets overfit and plateau, larger ones keep gaining —
+the curve the paper's 55%@27M-positions sits on (arXiv:1412.6564 via
+reference README.md:5).
+
+Writes one JSONL record per point to --out and a CSV next to it.
+
+Usage (flagship, on TPU):
+  python tools/accuracy_curve.py --data-root data/corpus/processed \
+      --budgets 4000,40000,400000,4000000 --iters 4000 \
+      --set num_layers=12 channels=128 batch_size=512
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepgo_tpu.cli import parse_overrides  # noqa: E402
+from deepgo_tpu.experiments import Experiment, ExperimentConfig  # noqa: E402
+from subset_split import subset_prefix_copy  # noqa: E402
+
+
+def run_point(cfg: ExperimentConfig, budget: int, iters: int,
+              data_root: str) -> dict:
+    split = f"train_{budget}"
+    split_dir = os.path.join(data_root, split)
+    if not os.path.exists(os.path.join(split_dir, "planes.bin")):
+        n = subset_prefix_copy(os.path.join(data_root, "train"), split_dir,
+                               budget)
+        print(f"built {split}: {n:,} positions", flush=True)
+
+    exp = Experiment(cfg.replace(name=f"curve-{budget}", train_split=split))
+    t0 = time.time()
+    summary = exp.run(iters)
+    test = exp.evaluate()  # full test split, deterministic
+    from deepgo_tpu.data import GoDataset
+
+    record = {
+        "budget": budget,
+        "actual_positions": len(GoDataset(data_root, split)),
+        "iters": iters,
+        "batch_size": cfg.batch_size,
+        "test_top1": test["accuracy"],
+        "test_nll": test["cost"],
+        "final_ewma": summary["final_ewma"],
+        "last_val": summary["last_validation"],
+        "samples_per_sec": summary["samples_per_sec"],
+        "seconds": time.time() - t0,
+        "run_id": exp.id,
+    }
+    print(json.dumps(record), flush=True)
+    return record
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--data-root", default="data/corpus/processed")
+    ap.add_argument("--budgets", default="4000,40000,400000,4000000")
+    ap.add_argument("--iters", type=int, default=4000)
+    ap.add_argument("--out", default="docs/accuracy_curve.jsonl")
+    ap.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
+    args = ap.parse_args(argv)
+
+    cfg = ExperimentConfig(data_root=args.data_root, scheme="uniform")
+    cfg = cfg.replace(**parse_overrides(args.set))
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    records = []
+    for budget in [int(b) for b in args.budgets.split(",")]:
+        record = run_point(cfg, budget, args.iters, args.data_root)
+        records.append(record)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    csv = args.out.rsplit(".", 1)[0] + ".csv"
+    with open(csv, "w") as f:
+        f.write("positions,test_top1,test_nll\n")
+        for r in records:
+            f.write(f"{r['actual_positions']},{r['test_top1']:.4f},"
+                    f"{r['test_nll']:.4f}\n")
+    print(f"wrote {args.out} and {csv}")
+
+
+if __name__ == "__main__":
+    main()

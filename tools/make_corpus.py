@@ -1,0 +1,114 @@
+"""Generate a synthetic arena corpus at KGS scale.
+
+The 55% KGS top-1 north star needs ~27M human positions that do not exist
+in this zero-egress environment (BASELINE.md; reference README.md:5), so
+the accuracy axis is exercised on data the framework generates itself:
+arena games between the scripted baselines (HeuristicAgent, OnePlyAgent),
+written as ranked SGFs and pushed through the exact same
+transcription -> shard -> loader -> train pipeline a real corpus would use
+(reference pipeline anchors: makedata.lua:517-576, data.lua:29-80).
+
+Agent identity is encoded in the dan-rank tags (oneply=8d, heuristic=4d),
+so the model can condition on "player strength" through the rank planes
+exactly like KGS dan ranks (reference dataloader.lua:12-13,87). Game pairs
+cycle through the three distinct matchups for move-distribution diversity
+(colors alternate inside each chunk, so both color assignments of the
+mixed pair occur — arena.play_match).
+
+Usage:
+  python tools/make_corpus.py --out data/corpus --positions 5000000
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepgo_tpu import arena  # noqa: E402
+from deepgo_tpu.selfplay import to_sgf  # noqa: E402
+
+RANK_OF = {"heuristic": 4, "oneply": 8}
+
+
+def split_of(gid: int) -> str:
+    """Deterministic 2% validation / 2% test / 96% train by game id."""
+    r = gid % 50
+    return {1: "validation", 2: "test"}.get(r, "train")
+
+
+def generate(out: str, target_positions: int, chunk: int, max_moves: int,
+             seed: int) -> dict:
+    pairs = [("oneply", "oneply"), ("oneply", "heuristic"),
+             ("heuristic", "heuristic")]
+    agents = {"heuristic": arena.HeuristicAgent(), "oneply": arena.OnePlyAgent()}
+    for split in ("train", "validation", "test"):
+        os.makedirs(os.path.join(out, "sgf", split), exist_ok=True)
+
+    totals = {"games": 0, "positions": 0, "truncated": 0}
+    t0 = time.time()
+    round_idx = 0
+    while totals["positions"] < target_positions:
+        name_a, name_b = pairs[round_idx % len(pairs)]
+        games, scores, stats = arena.play_match(
+            agents[name_a], agents[name_b], n_games=chunk,
+            max_moves=max_moves, seed=seed + round_idx)
+        totals["truncated"] += stats["truncated"]
+        for i, (g, s) in enumerate(zip(games, scores)):
+            gid = totals["games"]
+            totals["games"] += 1
+            totals["positions"] += len(g.moves)
+            split = split_of(gid)
+            # colors alternate inside play_match: even game index gives
+            # black to agent A
+            black, white = (name_a, name_b) if i % 2 == 0 else (name_b, name_a)
+            done = g.passes >= 2
+            path = os.path.join(out, "sgf", split, f"g{gid:07d}.sgf")
+            with open(path, "w") as f:
+                f.write(to_sgf(
+                    g,
+                    black_rank=RANK_OF[black], white_rank=RANK_OF[white],
+                    result=s.result_string() if done else None, komi=7.5))
+        round_idx += 1
+        rate = totals["positions"] / (time.time() - t0)
+        print(f"{totals['positions']:,}/{target_positions:,} positions "
+              f"({totals['games']:,} games, {rate:,.0f} pos/sec)", flush=True)
+    totals["gen_seconds"] = time.time() - t0
+    return totals
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="data/corpus")
+    ap.add_argument("--positions", type=int, default=5_000_000)
+    ap.add_argument("--chunk", type=int, default=1024,
+                    help="games advanced in lockstep per match call")
+    ap.add_argument("--max-moves", type=int, default=350)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--transcribe-workers", type=int,
+                    default=max(1, (os.cpu_count() or 2) - 1))
+    ap.add_argument("--skip-transcribe", action="store_true")
+    args = ap.parse_args(argv)
+
+    totals = generate(args.out, args.positions, args.chunk, args.max_moves,
+                      args.seed)
+    print(totals)
+
+    if not args.skip_transcribe:
+        from deepgo_tpu.data.transcribe import transcribe_split
+
+        for split in ("train", "validation", "test"):
+            t0 = time.time()
+            n = transcribe_split(
+                os.path.join(args.out, "sgf", split),
+                os.path.join(args.out, "processed", split),
+                workers=args.transcribe_workers, verbose=False)
+            print(f"transcribed {split}: {n:,} examples "
+                  f"in {time.time() - t0:.0f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
