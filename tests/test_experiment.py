@@ -183,7 +183,10 @@ def test_warm_restart_fresh_optimizer_new_id(data_root, tmp_path):
 def test_bad_batch_postmortem_capture(data_root, tmp_path):
     """A failing train step dumps the offending batch to bad_batch.npz
     (the reference kept it in globals, train.lua:106-109)."""
-    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
+    # steps_per_call is explicit because the auto setting resolves to 1 on
+    # the CPU test backend
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"),
+                      steps_per_call=10)
     exp = Experiment(cfg)
     exp.init()
 
@@ -199,11 +202,12 @@ def test_bad_batch_postmortem_capture(data_root, tmp_path):
     assert dump["packed"].shape == (10, cfg.batch_size, 9, 19, 19)
     assert set(dump.files) >= {"packed", "player", "rank", "target"}
 
-    exp2 = Experiment(tiny_config(data_root, run_dir=str(tmp_path / "runs2")))
+    exp2 = Experiment(tiny_config(data_root, run_dir=str(tmp_path / "runs2"),
+                                  steps_per_call=10))
     exp2.init()
     exp2.train_step = exploding_step
     with pytest.raises(FloatingPointError):
-        exp2.run(5)  # < print_interval -> single-step tail path
+        exp2.run(5)  # < steps_per_call -> single-step tail path
     dump = np.load(os.path.join(exp2.run_path, "bad_batch.npz"))
     assert dump["packed"].shape == (cfg.batch_size, 9, 19, 19)
 
