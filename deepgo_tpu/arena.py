@@ -33,7 +33,7 @@ from .go import BLACK, WHITE
 from .go.scoring import Score, area_score
 from .models import policy_cnn
 from .selfplay import (GameState, batched_log_probs, legal_mask,
-                       select_from_log_probs, step_game, summarize_state,
+                       select_from_log_probs, step_games, summarize_states,
                        to_sgf)
 
 
@@ -199,7 +199,7 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
         live = [i for i, g in enumerate(games) if not g.done]
         if not live:
             break
-        packed = np.stack([summarize_state(games[i]) for i in live])
+        packed = summarize_states([games[i] for i in live])
         players = np.array([games[i].player for i in live], dtype=np.int32)
         legal = legal_mask(packed, players, [games[i] for i in live])
         plies += len(live)
@@ -213,8 +213,7 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
                 moves[sel] = agent.select_moves(
                     packed[sel], players[sel], legal[sel], rng)
 
-        for j, i in enumerate(live):
-            step_game(games[i], int(moves[j]), max_moves)
+        step_games([games[i] for i in live], moves.tolist(), max_moves)
 
     scores = [area_score(g.stones, komi=komi) for g in games]
     dt = time.time() - t0

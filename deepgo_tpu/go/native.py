@@ -45,7 +45,11 @@ def load() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO_PATH) and not _build():
+        # Always invoke make: a no-op when the .so is newer than the
+        # source, a rebuild when a checkout left a stale .so missing newer
+        # symbols. A failed build with an existing .so (no compiler on
+        # this host) still loads the old library.
+        if not _build() and not os.path.exists(_SO_PATH):
             return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
@@ -63,6 +67,32 @@ def load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_uint8),
         ]
+        # goboard_summarize_batch is absent from stale pre-built .so files;
+        # treat it as optional so consumers can fall back per board.
+        try:
+            lib.goboard_summarize_batch.restype = None
+            lib.goboard_summarize_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int,
+            ]
+        except AttributeError:
+            pass
+        try:
+            lib.goboard_play_batch.restype = ctypes.c_int
+            lib.goboard_play_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int,
+            ]
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -96,6 +126,80 @@ def transcribe_game_native(handicaps, moves) -> np.ndarray:
         if rc <= -1000000:
             raise IllegalMoveError(f"illegal handicap placement #{-(rc + 1000000) - 1}")
         raise IllegalMoveError(f"illegal move #{-rc - 1}")
+    return out
+
+
+def batch_available() -> bool:
+    lib = load()
+    return (lib is not None and hasattr(lib, "goboard_summarize_batch")
+            and hasattr(lib, "goboard_play_batch"))
+
+
+def play_batch_native(stones: np.ndarray, age: np.ndarray, moves: np.ndarray,
+                      players: np.ndarray, n_threads: int = 0) -> np.ndarray:
+    """Apply one move per board IN PLACE across N boards in one native call.
+
+    ``stones`` (N, 19, 19) uint8 and ``age`` (N, 19, 19) int32 are mutated;
+    ``moves`` is (N,) int32 flat indices (-1 = pass, board untouched) and
+    ``players`` (N,) int32. Returns the (N,) int32 simple-ko points (flat
+    index of the banned recapture, -1 = none) — the native twin of
+    deepgo_tpu.selfplay.apply_move's ko rule. Raises IllegalMoveError if
+    any move lands on an occupied point.
+    """
+    lib = load()
+    assert lib is not None and hasattr(lib, "goboard_play_batch"), (
+        "native batch play unavailable")
+    assert stones.dtype == np.uint8 and stones.flags.c_contiguous
+    assert age.dtype == np.int32 and age.flags.c_contiguous
+    assert stones.ndim == 3 and stones.shape[1:] == (BOARD_SIZE, BOARD_SIZE)
+    assert age.shape == stones.shape
+    m = np.ascontiguousarray(moves, dtype=np.int32)
+    p = np.ascontiguousarray(players, dtype=np.int32)
+    n = stones.shape[0]
+    assert m.shape == (n,) and p.shape == (n,)
+    ko = np.empty(n, dtype=np.int32)
+    rc = lib.goboard_play_batch(
+        stones.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        age.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        m.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        p.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n,
+        ko.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n_threads,
+    )
+    if rc != 0:
+        from .board import IllegalMoveError
+
+        raise IllegalMoveError(f"illegal move on board #{-rc - 1}")
+    return ko
+
+
+def summarize_batch_native(stones: np.ndarray, age: np.ndarray,
+                           n_threads: int = 0) -> np.ndarray:
+    """Summarize N independent boards in one native call.
+
+    ``stones`` is (N, 19, 19) uint8, ``age`` (N, 19, 19) int32; returns
+    packed (N, 9, 19, 19) uint8 records. One FFI crossing for the whole
+    batch, fanned over C++ threads (n_threads <= 0 = all cores) — the
+    self-play/arena host path's replacement for a Python loop of per-board
+    calls (round-2 verdict item 6).
+    """
+    lib = load()
+    assert lib is not None and hasattr(lib, "goboard_summarize_batch"), (
+        "native batch summarize unavailable")
+    s = np.ascontiguousarray(stones, dtype=np.uint8)
+    a = np.ascontiguousarray(age, dtype=np.int32)
+    assert s.ndim == 3 and s.shape[1:] == (BOARD_SIZE, BOARD_SIZE)
+    assert a.shape == s.shape
+    n = s.shape[0]
+    out = np.empty((n, PACKED_CHANNELS, BOARD_SIZE, BOARD_SIZE), dtype=np.uint8)
+    lib.goboard_summarize_batch(
+        s.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n_threads,
+    )
     return out
 
 

@@ -12,9 +12,11 @@
 //
 // Build: make -C native   (produces native/build/libgoboard.so)
 
+#include <atomic>
 #include <bitset>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -188,6 +190,31 @@ void ladder_moves(uint8_t* stones, int p, const Mask& liberties,
 
 inline uint8_t clip255(size_t v) { return v > 255 ? 255 : static_cast<uint8_t>(v); }
 
+// Fan `worker(i)` over [0, n) with up to n_threads std::threads
+// (work-stealing via an atomic counter). Small batches run serially: the
+// per-board work is a few µs, so thread create/join would dominate.
+template <typename F>
+void run_batch(int n, int n_threads, F&& body) {
+  if (n_threads <= 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    n_threads = hw ? static_cast<int>(hw) : 1;
+  }
+  if (n_threads > n) n_threads = n > 0 ? n : 1;
+  constexpr int SERIAL_CUTOFF = 16;
+  if (n_threads == 1 || n < SERIAL_CUTOFF) {
+    for (int i = 0; i < n; ++i) body(i);
+    return;
+  }
+  std::atomic<int> next(0);
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) body(i);
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker);
+  for (auto& th : threads) th.join();
+}
+
 // Full position summary -> packed (9, 19, 19) record
 // (deepgo_tpu.go.summarize.summarize).
 void summarize(Board& b, uint8_t* out) {
@@ -299,6 +326,74 @@ void goboard_summarize(const uint8_t* stones, const int32_t* age, uint8_t* out) 
   std::memcpy(b.stones, stones, sizeof(b.stones));
   std::memcpy(b.age, age, sizeof(b.age));
   summarize(b, out);
+}
+
+// Batch move application for the self-play/arena hot path: board i plays
+// moves[i] (flat index, or -1 = pass: board untouched) for players[i],
+// with full capture resolution and aging, plus simple-ko detection
+// (deepgo_tpu.selfplay.apply_move): when the move captures exactly one
+// stone and the new stone sits as a lone chain with exactly one liberty,
+// ko_out[i] = that captured point, else -1. Returns 0, or -(1+i) for the
+// first board whose move landed on an occupied point.
+int goboard_play_batch(uint8_t* stones, int32_t* age, const int32_t* moves,
+                       const int32_t* players, int n, int32_t* ko_out,
+                       int n_threads) {
+  std::atomic<int> err(0);
+  run_batch(n, n_threads, [&](int i) {
+    Mask checked, group, libs, would_die;
+    Board b;
+    ko_out[i] = -1;
+    int p = moves[i];
+    if (p < 0) return;
+    uint8_t player = static_cast<uint8_t>(players[i]);
+    uint8_t opp = 3 - player;
+    uint8_t* st = stones + static_cast<size_t>(i) * NN;
+    int32_t* ag = age + static_cast<size_t>(i) * NN;
+    if (st[p] != EMPTY) {
+      int expect = 0;
+      err.compare_exchange_strong(expect, i + 1);
+      return;
+    }
+    // opposing chains whose sole liberty is p die with this move
+    for (int k = 0; k < ADJ.cnt[p]; ++k) {
+      int nb = ADJ.nbr[p][k];
+      if (st[nb] == opp && !checked.test(nb)) {
+        group_and_libs(st, nb, group, libs);
+        checked |= group;
+        if (libs.count() == 1 && libs.test(p)) would_die |= group;
+      }
+    }
+    std::memcpy(b.stones, st, sizeof(b.stones));
+    std::memcpy(b.age, ag, sizeof(b.age));
+    play(b, p, player);
+    std::memcpy(st, b.stones, sizeof(b.stones));
+    std::memcpy(ag, b.age, sizeof(b.age));
+    if (would_die.count() == 1) {
+      group_and_libs(st, p, group, libs);
+      if (group.count() == 1 && libs.count() == 1)
+        for (int q = 0; q < NN; ++q)
+          if (would_die.test(q)) {
+            ko_out[i] = q;
+            break;
+          }
+    }
+  });
+  return err.load() ? -err.load() : 0;
+}
+
+// Batch summary for the self-play/arena hot path: n boards (stones
+// n*361 bytes, age n*361 int32) -> n packed records, one FFI crossing for
+// the whole fleet of live games instead of one per board. Boards are
+// independent, so a work-stealing counter fans them across n_threads
+// std::threads (<=0 picks hardware_concurrency).
+void goboard_summarize_batch(const uint8_t* stones, const int32_t* age,
+                             int n, uint8_t* out, int n_threads) {
+  run_batch(n, n_threads, [&](int i) {
+    Board b;
+    std::memcpy(b.stones, stones + static_cast<size_t>(i) * NN, sizeof(b.stones));
+    std::memcpy(b.age, age + static_cast<size_t>(i) * NN, sizeof(b.age));
+    summarize(b, out + static_cast<size_t>(i) * PACKED_CHANNELS * NN);
+  });
 }
 
 }  // extern "C"

@@ -63,3 +63,76 @@ def test_transcribe_split_engine_parity(tmp_path):
     a = np.fromfile(tmp_path / "native" / "planes.bin", dtype=np.uint8)
     b = np.fromfile(tmp_path / "python" / "planes.bin", dtype=np.uint8)
     assert np.array_equal(a, b)
+
+
+def test_summarize_batch_matches_single():
+    rng = np.random.default_rng(7)
+    boards = []
+    for _ in range(16):
+        stones, age = new_board()
+        for _ in range(int(rng.integers(5, 150))):
+            x, y = rng.integers(0, 19, size=2)
+            if stones[x, y] == 0:
+                play(stones, age, int(x), int(y), int(rng.integers(1, 3)))
+        boards.append((stones, age))
+    got = native.summarize_batch_native(
+        np.stack([b[0] for b in boards]), np.stack([b[1] for b in boards]))
+    want = np.stack([native.summarize_native(s, a) for s, a in boards])
+    assert np.array_equal(got, want)
+
+
+def test_play_batch_matches_python_apply_move():
+    """Native batched stepping (boards + ages + simple-ko) must be
+    bit-identical to the pure-Python apply_move path over whole games."""
+    from deepgo_tpu.arena import HeuristicAgent, OnePlyAgent, play_match
+    import deepgo_tpu.go.native as nat
+
+    games_n, _, stats_n = play_match(OnePlyAgent(), HeuristicAgent(),
+                                     n_games=8, max_moves=120, seed=5)
+    orig = nat.batch_available
+    nat.batch_available = lambda: False
+    try:
+        games_p, _, stats_p = play_match(OnePlyAgent(), HeuristicAgent(),
+                                         n_games=8, max_moves=120, seed=5)
+    finally:
+        nat.batch_available = orig
+    for a, b in zip(games_n, games_p):
+        assert [(m.player, m.x, m.y) for m in a.moves] == [
+            (m.player, m.x, m.y) for m in b.moves]
+        assert np.array_equal(a.stones, b.stones)
+        assert np.array_equal(a.age, b.age)
+        assert a.ko_point == b.ko_point
+    assert stats_n["truncated"] == stats_p["truncated"]
+
+
+def test_play_batch_ko_detection():
+    """A single-stone capture leaving a lone 1-liberty stone sets the ko
+    point; the native answer must match apply_move's."""
+    from deepgo_tpu.selfplay import GameState, apply_move
+
+    # classic ko shape: black b1c2d1, white c1 in atari after black plays c2?
+    # Build directly: white stone at (2,2) surrounded by black (1,2),(3,2),(2,1)
+    # with (2,3) empty; black plays (2,3) capturing nothing... use apply_move
+    # as the oracle on a known ko: black captures the lone white stone.
+    g = GameState()
+    for x, y, p in [(1, 2, 1), (3, 2, 1), (2, 1, 1),  # black walls
+                    (1, 3, 2), (3, 3, 2), (2, 4, 2),  # white walls
+                    (2, 2, 2)]:  # white stone in the middle
+        play(g.stones, g.age, x, y, p)
+    g2 = GameState()
+    g2.stones[:] = g.stones
+    g2.age[:] = g.age
+    # black plays (2,3): captures the white (2,2)? no — (2,2) has liberty
+    # (2,3) only, so yes: single-stone capture -> ko at (2,2)
+    g.player = 1
+    apply_move(g, 2, 3)
+    stones = g2.stones[None].copy()
+    age = g2.age[None].copy()
+    ko = native.play_batch_native(
+        stones, age, np.array([2 * 19 + 3], dtype=np.int32),
+        np.array([1], dtype=np.int32))
+    assert np.array_equal(stones[0], g.stones)
+    assert np.array_equal(age[0], g.age)
+    want = -1 if g.ko_point is None else g.ko_point[0] * 19 + g.ko_point[1]
+    assert ko[0] == want
+    assert g.ko_point == (2, 2)  # the capture really was a ko
