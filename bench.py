@@ -12,8 +12,19 @@ individual dispatches is meaningless through the axon relay: completion
 notifications don't gate on remote execution, and per-call host fetches
 measure tunnel round-trips, not compute.)
 
-Prints exactly one JSON line:
+Prints exactly one JSON line (the driver contract):
   {"metric": ..., "value": N, "unit": "boards/sec", "vs_baseline": N/10000}
+
+Extra modes (round-2 verdict items 2 and 5), each also one JSON line:
+  --mode train     fused-training samples/sec at 3L/64 (reference default
+                   scale, experiments.lua:33-46) and 12L/128 (flagship),
+                   with an MFU estimate — the measurement the reference
+                   prints per iteration (train.lua:126,139)
+  --mode latency   batched-inference p50/p99 latency at serving batch sizes
+                   (64/256/1024). Each sample times one dispatch + scalar
+                   fetch round trip, so through the axon relay the numbers
+                   include tunnel RTT — an upper bound on on-host serving
+                   latency (stated in the JSON).
 """
 
 from __future__ import annotations
@@ -26,18 +37,28 @@ import numpy as np
 
 BASELINE_BOARDS_PER_SEC = 10_000.0
 
+# metric name per mode, so failure diagnostics attribute to the right
+# benchmark (a driver keying on "metric" must not see a failed *training*
+# run recorded under the inference metric)
+_METRIC_OF = {
+    "inference": ("policy_inference_boards_per_sec_per_chip", "boards/sec"),
+    "train": ("fused_training_samples_per_sec_per_chip", "samples/sec"),
+    "latency": ("policy_inference_latency_ms", "ms p50 (includes relay RTT)"),
+}
 
-def _diagnostic_json(error: str) -> str:
+
+def _diagnostic_json(error: str, mode: str = "inference") -> str:
+    metric, unit = _METRIC_OF[mode]
     return json.dumps({
-        "metric": "policy_inference_boards_per_sec_per_chip",
+        "metric": metric,
         "value": 0.0,
-        "unit": "boards/sec",
+        "unit": unit,
         "vs_baseline": 0.0,
         "error": error,
     })
 
 
-def _arm_watchdog():
+def _arm_watchdog(mode: str = "inference"):
     """Fail loudly if the device never answers.
 
     A wedged relay claim blocks in C code while holding the GIL, so an
@@ -56,11 +77,11 @@ def _arm_watchdog():
         "bench", float(os.environ.get("BENCH_WATCHDOG_S", "900")),
         diagnostic_json=_diagnostic_json(
             "device unreachable: watchdog fired before any result "
-            "(TPU relay claim likely wedged)"),
+            "(TPU relay claim likely wedged)", mode),
     )
 
 
-def _preflight_probe() -> None:
+def _preflight_probe(mode: str = "inference") -> None:
     """Claim-and-release the device in a child with a short timeout.
 
     A wedged relay then fails the bench in seconds (with a parseable JSON
@@ -83,18 +104,146 @@ def _preflight_probe() -> None:
     except subprocess.TimeoutExpired:
         print(_diagnostic_json(
             f"pre-flight device probe timed out after {timeout_s}s "
-            "(TPU relay claim likely wedged)"), flush=True)
+            "(TPU relay claim likely wedged)", mode), flush=True)
         raise SystemExit(1)
     if r.returncode != 0:
         print(_diagnostic_json(
-            "pre-flight device probe failed: " + r.stderr[-400:].strip()),
-            flush=True)
+            "pre-flight device probe failed: " + r.stderr[-400:].strip(),
+            mode), flush=True)
         raise SystemExit(1)
 
 
+def _conv_flops_per_sample(cfg) -> float:
+    """Forward-pass MAC*2 FLOPs of the conv stack for one 19x19 board."""
+    return sum(2.0 * k * k * cin * cout * 361
+               for k, cin, cout in cfg.layer_shapes())
+
+
+def _rand_batch(rng, shape_prefix) -> tuple:
+    """Synthetic packed records + player/rank vectors for any (K?, B) prefix."""
+    return (
+        rng.integers(0, 3, size=(*shape_prefix, 9, 19, 19), dtype=np.uint8),
+        rng.integers(1, 3, size=shape_prefix).astype(np.int32),
+        rng.integers(1, 10, size=shape_prefix).astype(np.int32),
+    )
+
+
+def _bench_train(on_tpu: bool) -> dict:
+    """Fused-training samples/sec: K chained optimizer steps per dispatch
+    (make_train_step_many), one scalar fetch to fence the measurement."""
+    import jax
+
+    from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.training import make_train_step_many
+    from deepgo_tpu.training.optimizers import OPTIMIZERS
+
+    rng = np.random.default_rng(0)
+    configs = [("3L/64", "small"), ("12L/128", "full")]
+    batch, k_steps, repeats = (1024, 16, 3) if on_tpu else (64, 2, 1)
+    out = {}
+    for label, name in configs:
+        cfg = policy_cnn.CONFIGS[name]
+        optimizer = OPTIMIZERS["sgd"](0.01, 1e-7, 0.0)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        opt_state = optimizer.init(params)
+        step = make_train_step_many(cfg, optimizer)
+        packed, player, rank = _rand_batch(rng, (k_steps, batch))
+        superbatch = {
+            "packed": jax.device_put(packed),
+            "player": jax.device_put(player),
+            "rank": jax.device_put(rank),
+            "target": jax.device_put(
+                rng.integers(0, 361, size=(k_steps, batch)).astype(np.int32)),
+        }
+        params, opt_state, losses = step(params, opt_state, superbatch)
+        assert np.isfinite(float(losses[-1])), "non-finite training loss"
+        times = []
+        for _ in range(repeats):
+            t0 = time.time()
+            params, opt_state, losses = step(params, opt_state, superbatch)
+            float(losses[-1])  # fence: all K steps must have executed
+            times.append(time.time() - t0)
+        dt = float(np.median(times))
+        sps = k_steps * batch / dt
+        out[label] = {
+            "samples_per_sec": round(sps, 1),
+            "ms_per_step": round(1000 * dt / k_steps, 3),
+        }
+        # fwd + bwd ~= 3x forward FLOPs (standard estimate)
+        out[label]["tflops_est"] = round(
+            3 * _conv_flops_per_sample(cfg) * sps / 1e12, 1)
+    # MFU against v5e peak bf16 (197 TFLOPs) on the flagship config
+    peak = 197.0
+    out["12L/128"]["mfu_est"] = round(out["12L/128"]["tflops_est"] / peak, 3)
+    return {
+        "metric": "fused_training_samples_per_sec_per_chip",
+        "value": out["12L/128"]["samples_per_sec"],
+        "unit": "samples/sec",
+        "vs_baseline": None,
+        "batch": batch,
+        "steps_per_call": k_steps,
+        "configs": out,
+    }
+
+
+def _bench_latency(on_tpu: bool) -> dict:
+    """p50/p99 per-batch inference latency at serving batch sizes. Each
+    sample is one dispatch + scalar-fetch round trip; through the axon
+    relay that includes tunnel RTT, so on-TPU numbers are an upper bound
+    on on-host serving latency."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.ops import expand_planes
+
+    cfg = policy_cnn.CONFIGS["full"]
+    params = policy_cnn.init(jax.random.key(0), cfg)
+
+    @jax.jit
+    def forward(params, packed, player, rank):
+        planes = expand_planes(packed, player, rank,
+                               dtype=jnp.dtype(cfg.compute_dtype))
+        return policy_cnn.apply(params, planes, cfg).sum()
+
+    rng = np.random.default_rng(0)
+    reps = 50 if on_tpu else 5
+    sizes = (64, 256, 1024) if on_tpu else (16,)
+    out = {}
+    for batch in sizes:
+        data = jax.device_put(_rand_batch(rng, (batch,)))
+        float(forward(params, *data))  # compile + warm
+        samples = []
+        for _ in range(reps):
+            t0 = time.time()
+            float(forward(params, *data))
+            samples.append(1000 * (time.time() - t0))
+        out[f"batch_{batch}"] = {
+            "p50_ms": round(float(np.percentile(samples, 50)), 2),
+            "p99_ms": round(float(np.percentile(samples, 99)), 2),
+            "boards_per_sec_at_p50": round(
+                batch / (np.percentile(samples, 50) / 1000), 1),
+        }
+    return {
+        "metric": "policy_inference_latency_ms",
+        "value": out[f"batch_{sizes[0]}"]["p50_ms"],
+        "unit": "ms p50 (includes relay RTT)",
+        "vs_baseline": None,
+        "reps": reps,
+        "batches": out,
+    }
+
+
 def main() -> None:
-    _preflight_probe()
-    watchdog = _arm_watchdog()
+    import argparse
+
+    ap = argparse.ArgumentParser(description="deepgo_tpu benchmarks")
+    ap.add_argument("--mode", default="inference",
+                    choices=["inference", "train", "latency"])
+    args = ap.parse_args()
+
+    _preflight_probe(args.mode)
+    watchdog = _arm_watchdog(args.mode)
     import jax
     import jax.numpy as jnp
 
@@ -103,6 +252,14 @@ def main() -> None:
 
     device = jax.devices()[0]
     on_tpu = device.platform != "cpu"
+
+    if args.mode != "inference":
+        result = (_bench_train if args.mode == "train" else _bench_latency)(on_tpu)
+        result["device"] = str(device)
+        watchdog.disarm()
+        print(json.dumps(result))
+        return
+
     # CPU fallback keeps the benchmark runnable anywhere; the headline
     # number is the TPU one.
     batch, k_batches, repeats = (8192, 8, 3) if on_tpu else (256, 2, 1)
@@ -122,13 +279,7 @@ def main() -> None:
 
     fn = jax.jit(run_many)
     rng = np.random.default_rng(0)
-    data = jax.device_put(
-        (
-            rng.integers(0, 3, size=(k_batches, batch, 9, 19, 19), dtype=np.uint8),
-            rng.integers(1, 3, size=(k_batches, batch)).astype(np.int32),
-            rng.integers(1, 10, size=(k_batches, batch)).astype(np.int32),
-        )
-    )
+    data = jax.device_put(_rand_batch(rng, (k_batches, batch)))
 
     value = float(fn(params, *data))  # compile + warm; also a sanity value
     assert np.isfinite(value), "non-finite benchmark output"

@@ -19,21 +19,32 @@ from .experiments import Experiment, ExperimentConfig
 
 
 def parse_overrides(pairs: list[str]) -> dict:
-    fields = {f.name: f for f in dataclasses.fields(ExperimentConfig)}
+    """``key=value`` strings -> typed config overrides.
+
+    Dispatches on the runtime type of each field's default value (bool
+    before int: bool subclasses int), not on the stringified annotation —
+    so config evolution (new field types) fails loudly here instead of
+    silently coercing to str."""
+    defaults = ExperimentConfig()
+    fields = {f.name for f in dataclasses.fields(ExperimentConfig)}
     out = {}
     for pair in pairs:
         key, _, raw = pair.partition("=")
         if key not in fields:
             raise SystemExit(f"unknown config field {key!r}; valid: {sorted(fields)}")
-        ftype = fields[key].type
-        if ftype == "bool":
+        default = getattr(defaults, key)
+        if isinstance(default, bool):
             out[key] = raw.lower() in ("1", "true", "yes")
-        elif ftype == "int":
+        elif isinstance(default, int):
             out[key] = int(raw)
-        elif ftype == "float":
+        elif isinstance(default, float):
             out[key] = float(raw)
-        else:
+        elif isinstance(default, str):
             out[key] = raw
+        else:
+            raise SystemExit(
+                f"field {key!r} has non-scalar type "
+                f"{type(default).__name__}; set it in code, not via --set")
     return out
 
 

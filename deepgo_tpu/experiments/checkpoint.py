@@ -51,6 +51,17 @@ def load_checkpoint(path: str):
     return meta, params_leaves, opt_leaves
 
 
+def load_meta(path: str) -> dict:
+    """Read only the metadata entry (config, step, validation_history) —
+    npz members load lazily, so this skips the weight arrays entirely.
+    Lets tools plot or inspect runs straight from a checkpoint (reference
+    plot.lua:5-29 plots from .model files the same way)."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+    assert meta.get("format_version") == FORMAT_VERSION, meta.get("format_version")
+    return meta
+
+
 def unflatten_like(template, leaves):
     """Rebuild a pytree with ``template``'s structure from flat ``leaves``."""
     treedef = jax.tree.structure(template)

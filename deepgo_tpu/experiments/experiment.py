@@ -375,9 +375,12 @@ class Experiment:
             batches.append(jax.device_put(batch, self.batch_sharding))
         return batches
 
-    def validate(self, val_batches: list[dict] | None = None) -> dict:
+    def validate(self, val_batches: list[dict] | None = None,
+                 record_history: bool = True) -> dict:
         """Mean NLL + top-1 accuracy over the fixed validation set
-        (reference eval_validation, train.lua:14-45)."""
+        (reference eval_validation, train.lua:14-45). ``record_history``
+        appends to validation_history (what checkpoints persist); one-off
+        evaluations pass False."""
         if val_batches is None:
             if not self.initialized:
                 self.init()
@@ -395,7 +398,8 @@ class Experiment:
             "accuracy": total_correct / total_n,
             "n": int(total_n),
         }
-        self.validation_history.append({"step": self.step, **record})
+        if record_history:
+            self.validation_history.append({"step": self.step, **record})
         return record
 
     def evaluate(self, split: str | None = None, limit: int | None = None) -> dict:
@@ -406,9 +410,7 @@ class Experiment:
         dataset = self._dataset(split or self.config.test_split)
         n = len(dataset) if limit is None else min(limit, len(dataset))
         batches = self._deterministic_batches(dataset, n)
-        result = self.validate(batches)
-        self.validation_history.pop()  # evaluate() is not validation
-        return result
+        return self.validate(batches, record_history=False)
 
     # ---- checkpointing ----
 

@@ -1,11 +1,15 @@
-"""Validation-curve plotting from run metrics.
+"""Validation-curve plotting from run metrics or bare checkpoints.
 
 The reference plots validation costs out of checkpoint files inside iTorch
-(plot.lua:5-29). Runs here stream JSONL metrics, so plotting reads those:
-emits a CSV (always) and a PNG when matplotlib is importable.
+(plot.lua:5-29). Runs here stream JSONL metrics, so plotting prefers those,
+but every checkpoint also carries its full ``validation_history``, so a
+bare ``checkpoint.npz`` (or a run dir holding only one) plots too — true
+parity with the reference's plot-from-.model workflow. Emits a CSV
+(always) and a PNG when matplotlib is importable.
 
 Usage:
   python -m deepgo_tpu.experiments.plot runs/<id> [runs/<id2> ...] [--out curves]
+  python -m deepgo_tpu.experiments.plot runs/<id>/checkpoint.npz
 """
 
 from __future__ import annotations
@@ -16,14 +20,31 @@ import os
 from ..utils.metrics import read_jsonl
 
 
+def _checkpoint_curve(path: str) -> list[tuple[int, float, float]]:
+    from .checkpoint import load_meta
+
+    meta = load_meta(path)
+    return [(r["step"], r["cost"], r["accuracy"])
+            for r in meta.get("validation_history", [])]
+
+
 def load_curves(run_dirs: list[str]) -> dict[str, list[tuple[int, float, float]]]:
+    """Per-run (step, cost, accuracy) rows. Each argument may be a run dir
+    (metrics.jsonl preferred, checkpoint.npz fallback) or a checkpoint file."""
     curves = {}
     for run_dir in run_dirs:
+        if run_dir.endswith(".npz"):
+            name = os.path.basename(os.path.dirname(run_dir)) or run_dir
+            curves[name] = _checkpoint_curve(run_dir)
+            continue
+        name = os.path.basename(run_dir.rstrip("/"))
         path = os.path.join(run_dir, "metrics.jsonl")
-        rows = [r for r in read_jsonl(path) if r["kind"] == "validation"]
-        curves[os.path.basename(run_dir.rstrip("/"))] = [
-            (r["step"], r["cost"], r["accuracy"]) for r in rows
-        ]
+        if os.path.exists(path):
+            rows = [r for r in read_jsonl(path) if r["kind"] == "validation"]
+            curves[name] = [(r["step"], r["cost"], r["accuracy"]) for r in rows]
+        else:
+            curves[name] = _checkpoint_curve(
+                os.path.join(run_dir, "checkpoint.npz"))
     return curves
 
 

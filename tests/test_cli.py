@@ -1,0 +1,33 @@
+"""CLI override parsing (the reference's torch.CmdLine + prototype tables)."""
+
+import pytest
+
+from deepgo_tpu.cli import parse_overrides
+
+
+def test_overrides_dispatch_on_default_value_types():
+    out = parse_overrides([
+        "batch_size=64", "rate=0.5", "augment=true", "name=sweep",
+        "channel_schedule=128,64", "rate_decay=1e-6",
+    ])
+    assert out == {"batch_size": 64, "rate": 0.5, "augment": True,
+                   "name": "sweep", "channel_schedule": "128,64",
+                   "rate_decay": 1e-6}
+    assert type(out["batch_size"]) is int
+    assert type(out["augment"]) is bool
+
+
+def test_overrides_bool_falsey_spellings():
+    assert parse_overrides(["augment=0"]) == {"augment": False}
+    assert parse_overrides(["augment=no"]) == {"augment": False}
+    assert parse_overrides(["augment=1"]) == {"augment": True}
+
+
+def test_overrides_unknown_field_rejected():
+    with pytest.raises(SystemExit):
+        parse_overrides(["no_such_field=1"])
+
+
+def test_overrides_bad_int_raises():
+    with pytest.raises(ValueError):
+        parse_overrides(["batch_size=many"])

@@ -51,3 +51,34 @@ def test_main_writes_png(tmp_path):
     out = tmp_path / "plots" / "curves"
     plot.main(_two_runs(tmp_path) + ["--out", str(out)])
     assert (out.parent / "curves.png").exists()
+
+
+def _write_checkpoint(path, history):
+    """A minimal real checkpoint (tiny params) carrying validation_history."""
+    import numpy as np
+
+    from deepgo_tpu.experiments import checkpoint as ckpt
+
+    ckpt.save_checkpoint(str(path), {"w": np.zeros(2)}, {"m": np.zeros(2)}, {
+        "id": "ck", "step": 200, "validation_history": history,
+        "config": {}, "git_sha": "none"})
+
+
+def test_load_curves_from_bare_checkpoint(tmp_path):
+    """Reference plot.lua:5-29 parity: plot straight from a checkpoint file,
+    no metrics.jsonl anywhere."""
+    history = [{"step": 100, "cost": 3.5, "accuracy": 0.1, "n": 64},
+               {"step": 200, "cost": 3.1, "accuracy": 0.2, "n": 64}]
+    run = tmp_path / "ckrun"
+    os.makedirs(run)
+    _write_checkpoint(run / "checkpoint.npz", history)
+    # via the checkpoint file path
+    curves = plot.load_curves([str(run / "checkpoint.npz")])
+    assert curves == {"ckrun": [(100, 3.5, 0.1), (200, 3.1, 0.2)]}
+    # via the run dir (metrics.jsonl absent -> checkpoint fallback)
+    curves = plot.load_curves([str(run)])
+    assert curves == {"ckrun": [(100, 3.5, 0.1), (200, 3.1, 0.2)]}
+    # metrics.jsonl, when present, still wins
+    _write_metrics(run, [
+        {"kind": "validation", "step": 300, "cost": 2.9, "accuracy": 0.25}])
+    assert plot.load_curves([str(run)]) == {"ckrun": [(300, 2.9, 0.25)]}
