@@ -172,9 +172,12 @@ def _bench_train(on_tpu: bool) -> dict:
         # fwd + bwd ~= 3x forward FLOPs (standard estimate)
         out[label]["tflops_est"] = round(
             3 * _conv_flops_per_sample(cfg) * sps / 1e12, 1)
-    # MFU against v5e peak bf16 (197 TFLOPs) on the flagship config
-    peak = 197.0
-    out["12L/128"]["mfu_est"] = round(out["12L/128"]["tflops_est"] / peak, 3)
+    if on_tpu:
+        # MFU against v5e peak bf16 (197 TFLOPs) on the flagship config;
+        # only meaningful on the TPU this bench targets, so gated
+        peak = 197.0
+        out["12L/128"]["mfu_est_v5e"] = round(
+            out["12L/128"]["tflops_est"] / peak, 3)
     return {
         "metric": "fused_training_samples_per_sec_per_chip",
         "value": out["12L/128"]["samples_per_sec"],

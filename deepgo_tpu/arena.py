@@ -254,7 +254,8 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
     return games, scores, stats
 
 
-def _make_agent(spec: str, seed: int, temperature: float = 0.0) -> Agent:
+def _make_agent(spec: str, seed: int, temperature: float = 0.0,
+                rank: int = 9) -> Agent:
     if spec == "random":
         return RandomAgent()
     if spec == "heuristic":
@@ -265,12 +266,13 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0) -> Agent:
         from .models.serving import load_policy
 
         _, params, cfg = load_policy(spec.split(":", 1)[1])
-        return PolicyAgent(params, cfg, name="policy", temperature=temperature)
+        return PolicyAgent(params, cfg, name="policy", temperature=temperature,
+                           rank=rank)
     if spec.startswith("model:"):  # random-init policy, for smoke runs
         cfg = policy_cnn.CONFIGS[spec.split(":", 1)[1]]
         params = policy_cnn.init(jax.random.key(seed), cfg)
         return PolicyAgent(params, cfg, name=f"init-{spec.split(':', 1)[1]}",
-                           temperature=temperature)
+                           temperature=temperature, rank=rank)
     raise ValueError(
         f"unknown agent spec {spec!r} "
         "(use random | heuristic | oneply | checkpoint:PATH | model:NAME)")
@@ -289,11 +291,15 @@ def main(argv=None) -> None:
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="softmax sampling temperature for policy agents "
                          "(0 = argmax; >0 diversifies policy-vs-policy games)")
+    ap.add_argument("--rank", type=int, default=9,
+                    help="dan rank fed to policy agents' rank planes; match "
+                         "the training corpus (e.g. 8 for the synthetic "
+                         "corpus, whose strongest games are tagged 8d)")
     ap.add_argument("--sgf-out", help="directory to write scored games")
     args = ap.parse_args(argv)
 
-    agent_a = _make_agent(args.a, args.seed, args.temperature)
-    agent_b = _make_agent(args.b, args.seed + 1, args.temperature)
+    agent_a = _make_agent(args.a, args.seed, args.temperature, args.rank)
+    agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank)
     games, scores, stats = play_match(agent_a, agent_b, n_games=args.games,
                                       komi=args.komi, max_moves=args.max_moves,
                                       seed=args.seed)

@@ -33,23 +33,27 @@ from subset_split import subset_prefix_copy  # noqa: E402
 
 
 def run_point(cfg: ExperimentConfig, budget: int, iters: int,
-              data_root: str) -> dict:
-    split = f"train_{budget}"
-    split_dir = os.path.join(data_root, split)
-    if not os.path.exists(os.path.join(split_dir, "planes.bin")):
-        n = subset_prefix_copy(os.path.join(data_root, "train"), split_dir,
-                               budget)
-        print(f"built {split}: {n:,} positions", flush=True)
+              data_root: str, full_size: int) -> dict:
+    if budget >= full_size:
+        split = "train"  # full corpus: no point copying 100% of the shard
+    else:
+        split = f"train_{budget}"
+        split_dir = os.path.join(data_root, split)
+        if not os.path.exists(os.path.join(split_dir, "planes.bin")):
+            n = subset_prefix_copy(os.path.join(data_root, "train"),
+                                   split_dir, budget)
+            print(f"built {split}: {n:,} positions", flush=True)
+
+    from deepgo_tpu.data import GoDataset
 
     exp = Experiment(cfg.replace(name=f"curve-{budget}", train_split=split))
     t0 = time.time()
     summary = exp.run(iters)
     test = exp.evaluate()  # full test split, deterministic
-    from deepgo_tpu.data import GoDataset
-
     record = {
         "budget": budget,
-        "actual_positions": len(GoDataset(data_root, split)),
+        "actual_positions": (full_size if split == "train"
+                             else len(GoDataset(data_root, split))),
         "iters": iters,
         "batch_size": cfg.batch_size,
         "test_top1": test["accuracy"],
@@ -76,10 +80,13 @@ def main(argv=None) -> None:
     cfg = ExperimentConfig(data_root=args.data_root, scheme="uniform")
     cfg = cfg.replace(**parse_overrides(args.set))
 
+    from deepgo_tpu.data import GoDataset
+
+    full_size = len(GoDataset(args.data_root, "train"))
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     records = []
     for budget in [int(b) for b in args.budgets.split(",")]:
-        record = run_point(cfg, budget, args.iters, args.data_root)
+        record = run_point(cfg, budget, args.iters, args.data_root, full_size)
         records.append(record)
         with open(args.out, "a") as f:
             f.write(json.dumps(record) + "\n")
