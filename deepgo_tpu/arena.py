@@ -105,10 +105,10 @@ class HeuristicAgent(Agent):
 class OnePlyAgent(Agent):
     """1-ply lookahead over every packed tactical channel.
 
-    Stronger than HeuristicAgent (~63% head-to-head over 60 games; see the
-    RESULTS win-rate table, and tests/test_arena.py for the vs-random
-    floor): for each legal point it weighs, from the to-move player's
-    perspective,
+    Stronger than HeuristicAgent (71.5% head-to-head over 200 games,
+    seed 7, 11 truncated — RESULTS.md win-rate table; tests/test_arena.py
+    checks the vs-random floor): for each legal point it weighs, from the
+    to-move player's perspective,
       * stones captured by playing there (P_KILLS, own channel),
       * stones SAVED by playing there — the opponent's capture count at the
         same point (P_KILLS, opponent channel): occupying it denies the
@@ -298,6 +298,9 @@ def main(argv=None) -> None:
     ap.add_argument("--sgf-out", help="directory to write scored games")
     args = ap.parse_args(argv)
 
+    from .utils import honor_platform_env
+
+    honor_platform_env()
     agent_a = _make_agent(args.a, args.seed, args.temperature, args.rank)
     agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank)
     games, scores, stats = play_match(agent_a, agent_b, n_games=args.games,

@@ -16,6 +16,7 @@ import argparse
 import dataclasses
 
 from .experiments import Experiment, ExperimentConfig
+from .utils import honor_platform_env
 
 
 def parse_overrides(pairs: list[str]) -> dict:
@@ -70,7 +71,7 @@ def cmd_eval(args) -> None:
 
 def cmd_localtest(args) -> None:
     """End-to-end smoke on the bundled data (reference localtest.lua:1-11)."""
-    config = ExperimentConfig(
+    defaults = dict(
         name="localtest",
         batch_size=16,
         channels=32,
@@ -78,8 +79,11 @@ def cmd_localtest(args) -> None:
         validation_interval=20,
         loader_threads=1,
         data_parallel=1,
-        **parse_overrides(args.set),
     )
+    # --set wins over the smoke-run defaults (the reference's override
+    # tables work the same way, localtest.lua:4-10)
+    defaults.update(parse_overrides(args.set))
+    config = ExperimentConfig(**defaults)
     exp = Experiment(config)
     summary = exp.run(args.iters)
     print(f"localtest done: final EWMA {summary['final_ewma']:.4f}")
@@ -107,6 +111,7 @@ def main(argv=None) -> None:
     p.set_defaults(fn=cmd_localtest)
 
     args = ap.parse_args(argv)
+    honor_platform_env()
     args.fn(args)
 
 

@@ -52,6 +52,9 @@ class ExperimentConfig:
     kernel: int = 3
     final_relu: bool = False
     compute_dtype: str = "bfloat16"
+    # rematerialize activations in backward (ModelConfig.remat): the
+    # HBM-vs-FLOPs trade for the 13L/256 config at large batch
+    remat: bool = False
     # optimization
     batch_size: int = 32
     rate: float = 0.01
@@ -99,6 +102,7 @@ class ExperimentConfig:
             kernel=self.kernel,
             final_relu=self.final_relu,
             compute_dtype=self.compute_dtype,
+            remat=self.remat,
         )
 
     def replace(self, **overrides) -> "ExperimentConfig":

@@ -269,6 +269,10 @@ def main(argv=None) -> None:
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
+    from .utils import honor_platform_env
+
+    honor_platform_env()
+
     if args.checkpoint:
         from .models.serving import load_policy
 

@@ -1,4 +1,23 @@
 """Utilities: metrics, timing, run identity."""
 
+import os
+
 from .metrics import MetricsWriter, append_registry  # noqa: F401
 from .gitinfo import git_sha  # noqa: F401
+
+
+def honor_platform_env() -> None:
+    """Re-assert JAX_PLATFORMS after interpreter start.
+
+    In the TPU terminal a sitecustomize force-selects the tunneled device,
+    silently overriding the environment variable; a backend config update
+    before first device use restores the user's choice (same pin as
+    tests/conftest.py). Without this, ``JAX_PLATFORMS=cpu`` CLI runs would
+    still dial the TPU relay — and block forever when its claim is wedged.
+    Call from CLI entry points before any device use.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)

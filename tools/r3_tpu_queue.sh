@@ -1,0 +1,97 @@
+#!/bin/bash
+# Round-3 TPU work queue: every chip-bound measurement, run sequentially so
+# only one process holds the single-tenant relay claim at a time. Each
+# stage appends to its own log under runs/r3logs/; a stage failure does not
+# stop later stages (the chip may recover mid-queue).
+#
+# Usage: bash tools/r3_tpu_queue.sh [stage ...]   (default: all stages)
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p runs/r3logs
+CORPUS=data/corpus/processed
+
+stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
+
+run_curve() {
+  stage curve
+  timeout 7200 python tools/accuracy_curve.py \
+    --data-root $CORPUS \
+    --budgets 4000,40000,400000,3294221 --iters 4000 \
+    --out docs/accuracy_curve.jsonl \
+    --set num_layers=12 channels=128 batch_size=512 \
+    >> runs/r3logs/curve.log 2>&1
+  echo "curve rc=$?"
+}
+
+run_converge() {
+  stage converge
+  timeout 10800 python -m deepgo_tpu.cli train --iters 16000 --set \
+    name=converge-12L128 data_root=$CORPUS scheme=uniform \
+    num_layers=12 channels=128 batch_size=1024 steps_per_call=20 \
+    rate=0.02 momentum=0.9 rate_decay=1e-7 \
+    validation_interval=2000 validation_size=4096 print_interval=100 \
+    >> runs/r3logs/converge.log 2>&1
+  echo "converge rc=$?"
+}
+
+run_arena() {
+  stage arena
+  CKPT=$(python - <<'PY'
+import json, os
+best = None
+for rid in os.listdir("runs"):
+    p = os.path.join("runs", rid, "checkpoint.npz")
+    if not os.path.exists(p):
+        continue
+    try:
+        from deepgo_tpu.experiments.checkpoint import load_meta
+        m = load_meta(p)
+    except Exception:
+        continue
+    if m.get("config", {}).get("name") == "converge-12L128":
+        if best is None or m["step"] > best[1]:
+            best = (p, m["step"])
+print(best[0] if best else "")
+PY
+)
+  echo "arena checkpoint: $CKPT"
+  [ -n "$CKPT" ] || { echo "no converge checkpoint; skipping arena"; return; }
+  for opp in oneply heuristic; do
+    timeout 3600 python -m deepgo_tpu.arena \
+      --a checkpoint:$CKPT --b $opp --games 200 --rank 8 --seed 11 \
+      --sgf-out runs/r3logs/arena_$opp \
+      >> runs/r3logs/arena.log 2>&1
+    echo "arena vs $opp rc=$?"
+  done
+  tail -4 runs/r3logs/arena.log
+}
+
+run_large() {
+  stage large-13L256
+  for remat in false true; do
+    timeout 3600 python -m deepgo_tpu.cli train --iters 300 --set \
+      name=large-remat-$remat data_root=$CORPUS scheme=uniform \
+      num_layers=13 channels=256 batch_size=4096 remat=$remat \
+      steps_per_call=10 rate=0.01 validation_interval=300 \
+      validation_size=2048 print_interval=50 \
+      >> runs/r3logs/large_$remat.log 2>&1
+    echo "large remat=$remat rc=$?"
+    grep "samples per second" runs/r3logs/large_$remat.log | tail -2
+  done
+}
+
+run_bench() {
+  stage bench
+  for mode in inference train latency; do
+    timeout 1200 python bench.py --mode $mode \
+      > runs/r3logs/bench_$mode.json 2> runs/r3logs/bench_$mode.err
+    echo "bench $mode rc=$?"
+    tail -1 runs/r3logs/bench_$mode.json
+  done
+}
+
+if [ $# -eq 0 ]; then
+  set -- curve converge arena large bench
+fi
+for s in "$@"; do run_$s; done
+echo "=== queue done [$(date -u +%H:%M:%S)] ==="
