@@ -136,3 +136,33 @@ def test_play_batch_ko_detection():
     want = -1 if g.ko_point is None else g.ko_point[0] * 19 + g.ko_point[1]
     assert ko[0] == want
     assert g.ko_point == (2, 2)  # the capture really was a ko
+
+
+def test_step_games_pass_and_done_handling():
+    """Passes, done games, and mixed batches behave identically on the
+    native and fallback paths: done games are never touched, passes lift
+    ko and count toward double-pass game end."""
+    from deepgo_tpu.selfplay import GameState, step_games
+    import deepgo_tpu.go.native as nat
+
+    def build():
+        gs = [GameState() for _ in range(4)]
+        gs[0].done = True  # finished game must stay frozen
+        gs[1].passes = 1   # one more pass ends it
+        gs[2].ko_point = (3, 3)  # pass lifts the ban
+        return gs
+
+    for use_native in (True, False):
+        gs = build()
+        orig = nat.batch_available
+        if not use_native:
+            nat.batch_available = lambda: False
+        try:
+            step_games(gs, [5, -1, -1, 42], max_moves=100)
+        finally:
+            nat.batch_available = orig
+        assert gs[0].moves == [] and gs[0].player == 1  # untouched
+        assert gs[1].done and gs[1].passes == 2
+        assert gs[2].ko_point is None and not gs[2].done
+        assert len(gs[3].moves) == 1 and gs[3].player == 2
+        assert gs[3].stones[divmod(42, 19)] == 1

@@ -77,6 +77,9 @@ def main(argv=None) -> None:
     ap.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
     args = ap.parse_args(argv)
 
+    from deepgo_tpu.utils import honor_platform_env
+
+    honor_platform_env()
     cfg = ExperimentConfig(data_root=args.data_root, scheme="uniform")
     cfg = cfg.replace(**parse_overrides(args.set))
 
@@ -98,6 +101,39 @@ def main(argv=None) -> None:
             f.write(f"{r['actual_positions']},{r['test_top1']:.4f},"
                     f"{r['test_nll']:.4f}\n")
     print(f"wrote {args.out} and {csv}")
+    plot_curve(args.out)
+
+
+def plot_curve(jsonl_path: str) -> str | None:
+    """Accuracy-vs-positions PNG (log x) from every record in the JSONL;
+    returns the PNG path, or None without matplotlib."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return None
+    with open(jsonl_path) as f:
+        rows = sorted((json.loads(line) for line in f if line.strip()),
+                      key=lambda r: r["actual_positions"])
+    if not rows:
+        return None
+    xs = [r["actual_positions"] for r in rows]
+    ys = [r["test_top1"] for r in rows]
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.semilogx(xs, ys, marker="o")
+    for x, y in zip(xs, ys):
+        ax.annotate(f"{y:.1%}", (x, y), textcoords="offset points",
+                    xytext=(0, 8), ha="center", fontsize=8)
+    ax.set_xlabel("training positions (log)")
+    ax.set_ylabel("test top-1 accuracy")
+    ax.set_title("Accuracy vs corpus size (same config, same steps)")
+    fig.tight_layout()
+    png = jsonl_path.rsplit(".", 1)[0] + ".png"
+    fig.savefig(png, dpi=120)
+    print(f"wrote {png}")
+    return png
 
 
 if __name__ == "__main__":

@@ -80,6 +80,17 @@ run_large() {
   done
 }
 
+run_selfplay() {
+  stage selfplay
+  CKPT=$(ls -t runs/*/checkpoint.npz 2>/dev/null | head -1)
+  [ -n "$CKPT" ] || { echo "no checkpoint; skipping selfplay"; return; }
+  timeout 3600 python -m deepgo_tpu.selfplay \
+    --games 256 --checkpoint "$CKPT" --max-moves 250 \
+    >> runs/r3logs/selfplay.log 2>&1
+  echo "selfplay rc=$?"
+  tail -1 runs/r3logs/selfplay.log
+}
+
 run_bench() {
   stage bench
   for mode in inference train latency; do
@@ -91,7 +102,7 @@ run_bench() {
 }
 
 if [ $# -eq 0 ]; then
-  set -- curve converge arena large bench
+  set -- curve converge arena selfplay large bench
 fi
 for s in "$@"; do run_$s; done
 echo "=== queue done [$(date -u +%H:%M:%S)] ==="
