@@ -19,6 +19,11 @@ Sampling schemes:
     games relative to the position-uniform distribution.
   * ``uniform``  uniform over positions (the corrected option,
     SURVEY.md section 7.6).
+  * ``winner``   uniform over positions where the side to move went on to
+    win the game — outcome-conditioned imitation (train on the winner's
+    moves only). Requires a ``winner.npy`` sidecar built by
+    tools/winner_index.py from the split's SGF results; the reference has
+    no outcome information in its format at all.
 """
 
 from __future__ import annotations
@@ -62,6 +67,11 @@ class GoDataset:
         self.game_ranges = np.array([[g["start"], g["count"]] for g in games],
                                     dtype=np.int64)
         assert (self.game_ranges[:, 1] > 0).all()
+        # optional per-position game-winner sidecar (1 black / 2 white /
+        # 0 unknown or draw), built by tools/winner_index.py
+        wpath = os.path.join(self.dir, "winner.npy")
+        self.winner = np.load(wpath) if os.path.exists(wpath) else None
+        self._winner_positions: np.ndarray | None = None
 
     def __len__(self) -> int:
         return int(self.meta.shape[0])
@@ -79,7 +89,25 @@ class GoDataset:
             starts = self.game_ranges[games, 0]
             counts = self.game_ranges[games, 1]
             return starts + (rng.random(n) * counts).astype(np.int64)
+        if scheme == "winner":
+            cand = self.winner_positions()
+            return cand[rng.integers(0, cand.size, size=n)]
         raise ValueError(f"unknown sampling scheme {scheme!r}")
+
+    def winner_positions(self) -> np.ndarray:
+        """Indices of positions whose side to move won the game (decided
+        games only). Cached; requires the winner.npy sidecar."""
+        if self._winner_positions is None:
+            if self.winner is None:
+                raise FileNotFoundError(
+                    f"scheme='winner' needs {self.dir}/winner.npy — build it "
+                    "with python tools/winner_index.py")
+            assert self.winner.shape[0] == len(self)
+            self._winner_positions = np.flatnonzero(
+                self.winner == self.meta[:, M_PLAYER])
+            assert self._winner_positions.size > 0, (
+                "no decided-game positions in this split")
+        return self._winner_positions
 
     def batch_at(self, indices: np.ndarray):
         """Gather (packed_planes, to_move_player, rank_of_player, target)."""
@@ -168,4 +196,10 @@ class DatasetWriter:
         np.save(os.path.join(self.out_dir, "meta.npy"), meta)
         with open(os.path.join(self.out_dir, "games.json"), "w") as f:
             json.dump(self._games, f)
+        # a winner.npy sidecar describes the OLD shard; a re-transcription
+        # with the same position count would otherwise silently keep stale
+        # outcome labels (rebuild with tools/winner_index.py)
+        stale = os.path.join(self.out_dir, "winner.npy")
+        if os.path.exists(stale):
+            os.remove(stale)
         return self._count

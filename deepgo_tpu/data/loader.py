@@ -62,6 +62,12 @@ class AsyncLoader:
         self.dataset = dataset
         self.batch_size = batch_size
         self.scheme = scheme
+        if scheme == "winner":
+            # fail fast here, not inside a worker thread: a sampler raise
+            # in a worker dies silently and get() then blocks forever on
+            # the empty queue (missing winner.npy would otherwise burn a
+            # whole run's timeout)
+            dataset.winner_positions()
         self.sharding = sharding
         self.augment = augment
         self.stack = stack

@@ -128,3 +128,43 @@ def test_transcribe_game_skips_unranked(tmp_path):
     packed, meta = transcribe_game(str(p))
     assert packed.shape == (2, 9, 19, 19)
     assert meta[0].tolist() == [1, 15, 3, 3, 1, 0]
+
+
+def test_winner_scheme_samples_only_winner_moves(tmp_path):
+    """Outcome-conditioned sampling: scheme='winner' draws only positions
+    whose side to move won (per the SGF RE tag); undecided games excluded."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    from winner_index import build
+
+    sgf_dir = tmp_path / "sgf"
+    os.makedirs(sgf_dir)
+    # black wins game 0, white wins game 1, game 2 has no result
+    records = {
+        "a.sgf": "(;GM[1]SZ[19]BR[8d]WR[8d]RE[B+10.5];B[aa];W[bb];B[cc])",
+        "b.sgf": "(;GM[1]SZ[19]BR[8d]WR[8d]RE[W+3];B[dd];W[ee];B[ff];W[gg])",
+        "c.sgf": "(;GM[1]SZ[19]BR[8d]WR[8d];B[hh];W[ii])",
+    }
+    for name, text in records.items():
+        (sgf_dir / name).write_text(text)
+    out = tmp_path / "processed"
+    n = transcribe_split(str(sgf_dir), str(out), workers=1, verbose=False)
+    assert n == 9
+
+    stats = build(str(out), str(sgf_dir))
+    assert stats == {"games": 3, "decided": 2, "undecided": 1, "missing": 0,
+                     "winner_positions": 2 + 2}  # B moves of a + W moves of b
+
+    ds = GoDataset(str(tmp_path), "processed")
+    idx = ds.sample_indices(np.random.default_rng(0), 64, scheme="winner")
+    # every sampled position: mover == game winner, and game is decided
+    assert (ds.winner[idx] == ds.meta[idx, 0]).all()
+    assert set(np.unique(ds.meta[idx, M_GAME])) <= {0, 1}
+    # the loader plumbs the scheme through untouched
+    from deepgo_tpu.data.loader import AsyncLoader
+
+    with AsyncLoader(ds, 8, scheme="winner", seed=1, num_threads=0,
+                     prefetch=2) as loader:
+        batch = loader.get(stack=0)
+    assert batch["packed"].shape[0] == 8

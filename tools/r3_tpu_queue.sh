@@ -34,35 +34,66 @@ run_converge() {
   echo "converge rc=$?"
 }
 
-run_arena() {
-  stage arena
-  CKPT=$(python - <<'PY'
-import json, os
+# newest checkpoint whose config name is $1 (empty if none)
+find_ckpt() {
+  NAME=$1 python - <<'PY'
+import os
+from deepgo_tpu.experiments.checkpoint import load_meta
+want = os.environ["NAME"]
 best = None
 for rid in os.listdir("runs"):
     p = os.path.join("runs", rid, "checkpoint.npz")
     if not os.path.exists(p):
         continue
     try:
-        from deepgo_tpu.experiments.checkpoint import load_meta
         m = load_meta(p)
     except Exception:
         continue
-    if m.get("config", {}).get("name") == "converge-12L128":
+    if m.get("config", {}).get("name") == want:
         if best is None or m["step"] > best[1]:
             best = (p, m["step"])
 print(best[0] if best else "")
 PY
-)
-  echo "arena checkpoint: $CKPT"
-  [ -n "$CKPT" ] || { echo "no converge checkpoint; skipping arena"; return; }
+}
+
+# 200-game matches of checkpoint $1 vs oneply and heuristic, tag $2
+match_vs_baselines() {
   for opp in oneply heuristic; do
     timeout 3600 python -m deepgo_tpu.arena \
-      --a checkpoint:$CKPT --b $opp --games 200 --rank 8 --seed 11 \
-      --sgf-out runs/r3logs/arena_$opp \
+      --a checkpoint:$1 --b $opp --games 200 --rank 8 --seed 11 \
+      --sgf-out runs/r3logs/arena_$2_$opp \
       >> runs/r3logs/arena.log 2>&1
-    echo "arena vs $opp rc=$?"
+    echo "arena $2 vs $opp rc=$?"
   done
+}
+
+run_arena() {
+  stage arena
+  CKPT=$(find_ckpt converge-12L128)
+  echo "arena checkpoint: $CKPT"
+  [ -n "$CKPT" ] || { echo "no converge checkpoint; skipping arena"; return; }
+  match_vs_baselines "$CKPT" base
+  tail -4 runs/r3logs/arena.log
+}
+
+run_finetune() {
+  stage finetune-winner
+  CKPT=$(find_ckpt converge-12L128)
+  [ -n "$CKPT" ] || { echo "no converge checkpoint; skipping finetune"; return; }
+  for s in train validation; do
+    [ -f $CORPUS/$s/winner.npy ] || timeout 900 python tools/winner_index.py \
+      --processed $CORPUS/$s --sgf data/corpus/sgf/$s \
+      >> runs/r3logs/finetune.log 2>&1
+  done
+  timeout 7200 python -m deepgo_tpu.experiments.repeated \
+    --checkpoint "$CKPT" --iters 4000 --set \
+    name=ft-winner scheme=winner rate=0.005 momentum=0.9 steps_per_call=20 \
+    print_interval=100 validation_interval=2000 validation_size=4096 \
+    >> runs/r3logs/finetune.log 2>&1
+  echo "finetune rc=$?"
+  FT=$(find_ckpt ft-winner)
+  [ -n "$FT" ] || { echo "no finetune checkpoint"; return; }
+  match_vs_baselines "$FT" ftwinner
   tail -4 runs/r3logs/arena.log
 }
 
@@ -102,7 +133,7 @@ run_bench() {
 }
 
 if [ $# -eq 0 ]; then
-  set -- curve converge arena selfplay large bench
+  set -- curve converge arena finetune selfplay large bench
 fi
 for s in "$@"; do run_$s; done
 echo "=== queue done [$(date -u +%H:%M:%S)] ==="
