@@ -87,8 +87,17 @@ def main(argv=None) -> None:
 
     full_size = len(GoDataset(args.data_root, "train"))
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    # resume-friendly: budgets already recorded in --out are not re-trained
+    # (a relay flap mid-sweep then only costs the interrupted point)
     records = []
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+    done = {r["budget"] for r in records}
     for budget in [int(b) for b in args.budgets.split(",")]:
+        if budget in done:
+            print(f"budget {budget} already recorded; skipping", flush=True)
+            continue
         record = run_point(cfg, budget, args.iters, args.data_root, full_size)
         records.append(record)
         with open(args.out, "a") as f:

@@ -12,9 +12,52 @@ CORPUS=data/corpus/processed
 
 stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
 
+# Real-compute canary: the relay can be in a state where claim probes
+# succeed but computation wedges, so gate every stage on an actual jitted
+# matmul round-trip. Returns nonzero (and the caller skips the stage) if
+# the chip is not answering.
+canary() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128))
+print('canary', float(jax.jit(lambda a: (a @ a).sum())(x)))" \
+    >/dev/null 2>&1
+}
+
+# supervise <log> <stall_s> <cmd...>: run cmd, kill it if <log> stops
+# growing for <stall_s> seconds (a wedge mid-stage otherwise burns the
+# stage's whole timeout). rc 97 = killed for stalling.
+supervise() {
+  local log=$1 stall=$2; shift 2
+  "$@" &
+  local pid=$! last=-1 same=0
+  while kill -0 $pid 2>/dev/null; do
+    sleep 30
+    local size=$(stat -c %s "$log" 2>/dev/null || echo 0)
+    if [ "$size" = "$last" ]; then
+      same=$((same + 30))
+      if [ $same -ge $stall ]; then
+        echo "supervise: killing stalled pid $pid (log $log frozen ${same}s)"
+        kill $pid 2>/dev/null; sleep 2; kill -9 $pid 2>/dev/null
+        # kill the whole process group's children (timeout wraps python)
+        pkill -9 -P $pid 2>/dev/null
+        return 97
+      fi
+    else
+      same=0; last=$size
+    fi
+  done
+  wait $pid
+}
+
 run_curve() {
   stage curve
-  timeout 7200 python tools/accuracy_curve.py \
+  if [ "$(wc -l < docs/accuracy_curve.jsonl 2>/dev/null || echo 0)" -ge 4 ]; then
+    echo "curve already has 4 points; skipping"; return 0
+  fi
+  canary || { echo "canary failed; skipping curve"; return 1; }
+  supervise runs/r3logs/curve.log 600 \
+    timeout 7200 python -u tools/accuracy_curve.py \
     --data-root $CORPUS \
     --budgets 4000,40000,400000,3294221 --iters 4000 \
     --out docs/accuracy_curve.jsonl \
@@ -23,18 +66,35 @@ run_curve() {
   echo "curve rc=$?"
 }
 
+CONVERGE_ITERS=16000
+
 run_converge() {
   stage converge
-  timeout 10800 python -m deepgo_tpu.cli train --iters 16000 --set \
-    name=converge-12L128 data_root=$CORPUS scheme=uniform \
-    num_layers=12 channels=128 batch_size=1024 steps_per_call=20 \
-    rate=0.02 momentum=0.9 rate_decay=1e-7 \
-    validation_interval=2000 validation_size=4096 print_interval=100 \
-    >> runs/r3logs/converge.log 2>&1
+  read -r CKPT STEP <<< "$(find_ckpt converge-12L128)"
+  if [ -n "${CKPT:-}" ] && [ "${STEP:-0}" -ge $CONVERGE_ITERS ]; then
+    echo "converge already at step $STEP; skipping"; return 0
+  fi
+  canary || { echo "canary failed; skipping converge"; return 1; }
+  if [ -n "${CKPT:-}" ]; then
+    # save-on-validate checkpoints make a killed run resumable
+    echo "resuming converge from $CKPT (step $STEP)"
+    supervise runs/r3logs/converge.log 600 \
+      timeout 10800 python -u -m deepgo_tpu.cli train \
+      --resume "$CKPT" --iters $((CONVERGE_ITERS - STEP)) \
+      >> runs/r3logs/converge.log 2>&1
+  else
+    supervise runs/r3logs/converge.log 600 \
+      timeout 10800 python -u -m deepgo_tpu.cli train --iters $CONVERGE_ITERS --set \
+      name=converge-12L128 data_root=$CORPUS scheme=uniform \
+      num_layers=12 channels=128 batch_size=1024 steps_per_call=20 \
+      rate=0.02 momentum=0.9 rate_decay=1e-7 \
+      validation_interval=2000 validation_size=4096 print_interval=100 \
+      >> runs/r3logs/converge.log 2>&1
+  fi
   echo "converge rc=$?"
 }
 
-# newest checkpoint whose config name is $1 (empty if none)
+# newest checkpoint whose config name is $1 -> "path step" (empty if none)
 find_ckpt() {
   NAME=$1 python - <<'PY'
 import os
@@ -52,47 +112,60 @@ for rid in os.listdir("runs"):
     if m.get("config", {}).get("name") == want:
         if best is None or m["step"] > best[1]:
             best = (p, m["step"])
-print(best[0] if best else "")
+print(f"{best[0]} {best[1]}" if best else "")
 PY
 }
 
 # 200-game matches of checkpoint $1 vs oneply and heuristic, tag $2
 match_vs_baselines() {
   for opp in oneply heuristic; do
-    timeout 3600 python -m deepgo_tpu.arena \
+    local mark=runs/r3logs/done_arena_$2_$opp
+    [ -f "$mark" ] && { echo "arena $2 vs $opp already done"; continue; }
+    canary || { echo "canary failed; skipping $2 vs $opp"; return 1; }
+    supervise runs/r3logs/arena.log 600 \
+      timeout 3600 python -u -m deepgo_tpu.arena \
       --a checkpoint:$1 --b $opp --games 200 --rank 8 --seed 11 \
       --sgf-out runs/r3logs/arena_$2_$opp \
       >> runs/r3logs/arena.log 2>&1
-    echo "arena $2 vs $opp rc=$?"
+    local rc=$?
+    [ $rc -eq 0 ] && touch "$mark"
+    echo "arena $2 vs $opp rc=$rc"
   done
 }
 
 run_arena() {
   stage arena
-  CKPT=$(find_ckpt converge-12L128)
-  echo "arena checkpoint: $CKPT"
-  [ -n "$CKPT" ] || { echo "no converge checkpoint; skipping arena"; return; }
+  read -r CKPT STEP <<< "$(find_ckpt converge-12L128)"
+  echo "arena checkpoint: ${CKPT:-none} (step ${STEP:-0})"
+  [ -n "${CKPT:-}" ] || { echo "no converge checkpoint; skipping arena"; return; }
   match_vs_baselines "$CKPT" base
   tail -4 runs/r3logs/arena.log
 }
 
 run_finetune() {
   stage finetune-winner
-  CKPT=$(find_ckpt converge-12L128)
-  [ -n "$CKPT" ] || { echo "no converge checkpoint; skipping finetune"; return; }
-  for s in train validation; do
-    [ -f $CORPUS/$s/winner.npy ] || timeout 900 python tools/winner_index.py \
-      --processed $CORPUS/$s --sgf data/corpus/sgf/$s \
+  read -r CKPT STEP <<< "$(find_ckpt converge-12L128)"
+  [ -n "${CKPT:-}" ] || { echo "no converge checkpoint; skipping finetune"; return; }
+  read -r FT FT_STEP <<< "$(find_ckpt ft-winner)"
+  if [ -z "${FT:-}" ] || [ "${FT_STEP:-0}" -lt $((STEP + 4000)) ]; then
+    for s in train validation; do
+      [ -f $CORPUS/$s/winner.npy ] || timeout 900 python tools/winner_index.py \
+        --processed $CORPUS/$s --sgf data/corpus/sgf/$s \
+        >> runs/r3logs/finetune.log 2>&1
+    done
+    canary || { echo "canary failed; skipping finetune"; return 1; }
+    supervise runs/r3logs/finetune.log 600 \
+      timeout 7200 python -u -m deepgo_tpu.experiments.repeated \
+      --checkpoint "$CKPT" --iters 4000 --set \
+      name=ft-winner scheme=winner rate=0.005 momentum=0.9 steps_per_call=20 \
+      print_interval=100 validation_interval=2000 validation_size=4096 \
       >> runs/r3logs/finetune.log 2>&1
-  done
-  timeout 7200 python -m deepgo_tpu.experiments.repeated \
-    --checkpoint "$CKPT" --iters 4000 --set \
-    name=ft-winner scheme=winner rate=0.005 momentum=0.9 steps_per_call=20 \
-    print_interval=100 validation_interval=2000 validation_size=4096 \
-    >> runs/r3logs/finetune.log 2>&1
-  echo "finetune rc=$?"
-  FT=$(find_ckpt ft-winner)
-  [ -n "$FT" ] || { echo "no finetune checkpoint"; return; }
+    echo "finetune rc=$?"
+    read -r FT FT_STEP <<< "$(find_ckpt ft-winner)"
+  else
+    echo "finetune already at step $FT_STEP; skipping training"
+  fi
+  [ -n "${FT:-}" ] || { echo "no finetune checkpoint"; return; }
   match_vs_baselines "$FT" ftwinner
   tail -4 runs/r3logs/arena.log
 }
@@ -100,37 +173,71 @@ run_finetune() {
 run_large() {
   stage large-13L256
   for remat in false true; do
-    timeout 3600 python -m deepgo_tpu.cli train --iters 300 --set \
+    [ -f runs/r3logs/done_large_$remat ] && { echo "large remat=$remat already done"; continue; }
+    canary || { echo "canary failed; skipping large remat=$remat"; return 1; }
+    supervise runs/r3logs/large_$remat.log 600 \
+      timeout 3600 python -u -m deepgo_tpu.cli train --iters 300 --set \
       name=large-remat-$remat data_root=$CORPUS scheme=uniform \
       num_layers=13 channels=256 batch_size=4096 remat=$remat \
       steps_per_call=10 rate=0.01 validation_interval=300 \
       validation_size=2048 print_interval=50 \
       >> runs/r3logs/large_$remat.log 2>&1
-    echo "large remat=$remat rc=$?"
+    rc=$?
+    [ $rc -eq 0 ] && touch runs/r3logs/done_large_$remat
+    echo "large remat=$remat rc=$rc"
     grep "samples per second" runs/r3logs/large_$remat.log | tail -2
   done
 }
 
 run_selfplay() {
   stage selfplay
+  [ -f runs/r3logs/done_selfplay ] && { echo "selfplay already done"; return 0; }
   CKPT=$(ls -t runs/*/checkpoint.npz 2>/dev/null | head -1)
   [ -n "$CKPT" ] || { echo "no checkpoint; skipping selfplay"; return; }
-  timeout 3600 python -m deepgo_tpu.selfplay \
+  canary || { echo "canary failed; skipping selfplay"; return 1; }
+  supervise runs/r3logs/selfplay.log 600 \
+    timeout 3600 python -u -m deepgo_tpu.selfplay \
     --games 256 --checkpoint "$CKPT" --max-moves 250 \
     >> runs/r3logs/selfplay.log 2>&1
-  echo "selfplay rc=$?"
+  rc=$?
+  [ $rc -eq 0 ] && touch runs/r3logs/done_selfplay
+  echo "selfplay rc=$rc"
   tail -1 runs/r3logs/selfplay.log
 }
 
 run_bench() {
   stage bench
   for mode in inference train latency; do
+    if [ -s runs/r3logs/bench_$mode.json ] \
+        && ! grep -q '"error"' runs/r3logs/bench_$mode.json; then
+      echo "bench $mode already done"; continue
+    fi
+    canary || { echo "canary failed; skipping bench $mode"; return 1; }
     timeout 1200 python bench.py --mode $mode \
       > runs/r3logs/bench_$mode.json 2> runs/r3logs/bench_$mode.err
     echo "bench $mode rc=$?"
     tail -1 runs/r3logs/bench_$mode.json
   done
 }
+
+if [ "${1:-}" = "--until-done" ]; then
+  # outer driver for a flapping chip: every stage is idempotent, so just
+  # re-run the whole queue until nothing is left to do (or attempts run
+  # out), waiting for a live canary between rounds
+  for attempt in $(seq 1 30); do
+    echo "=== until-done attempt $attempt [$(date -u +%H:%M:%S)] ==="
+    until canary; do echo "canary down; waiting"; sleep 120; done
+    out=$(bash "$0" 2>&1)
+    echo "$out"
+    if ! echo "$out" | grep -qE "canary failed|rc=[1-9]"; then
+      echo "=== all stages complete ==="
+      exit 0
+    fi
+    sleep 60
+  done
+  echo "=== attempts exhausted ==="
+  exit 1
+fi
 
 if [ $# -eq 0 ]; then
   set -- curve converge arena finetune selfplay large bench
