@@ -68,17 +68,30 @@ def _no_own_eyes(packed, players, legal):
     return legal & ~eye.reshape(n, -1)
 
 
+def _argmax_random_tiebreak(score: np.ndarray, legal: np.ndarray,
+                            rng: np.random.Generator) -> np.ndarray:
+    """Per-row argmax of integer ``score`` over ``legal`` points, ties
+    broken uniformly, -1 where nothing is legal — vectorized.
+
+    Adding iid U(0,1) noise to integer-valued scores keeps the order
+    between distinct scores (gaps >= 1) while the argmax over a tie set
+    follows the noise alone, i.e. uniform over the ties — one argmax for
+    the whole batch instead of a flatnonzero + rng.choice Python loop per
+    game (the hot loop once move application went native).
+    """
+    noisy = np.where(legal, score.astype(np.float64) + rng.random(score.shape),
+                     -np.inf)
+    moves = noisy.argmax(axis=1)
+    return np.where(legal.any(axis=1), moves, -1)
+
+
 class RandomAgent(Agent):
     name = "random"
 
     def select_moves(self, packed, players, legal, rng):
         legal = _no_own_eyes(packed, players, legal)
-        moves = np.full(len(packed), -1, dtype=np.int64)
-        for i in range(len(packed)):
-            choices = np.flatnonzero(legal[i])
-            if choices.size:
-                moves[i] = rng.choice(choices)
-        return moves
+        return _argmax_random_tiebreak(
+            np.zeros(legal.shape, dtype=np.int64), legal, rng)
 
 
 class HeuristicAgent(Agent):
@@ -92,21 +105,15 @@ class HeuristicAgent(Agent):
         idx = np.arange(n)
         kills = packed[idx, P_KILLS + players - 1].reshape(n, -1).astype(np.int64)
         libs = packed[idx, P_LIB_AFTER + players - 1].reshape(n, -1).astype(np.int64)
-        # lexicographic (kills, libs, jitter) over legal points
-        score = np.where(legal, (kills << 20) + (libs << 10), -1)
-        moves = np.full(n, -1, dtype=np.int64)
-        for i in range(n):
-            best = score[i].max()
-            if best >= 0:
-                moves[i] = rng.choice(np.flatnonzero(score[i] == best))
-        return moves
+        # lexicographic (kills, libs, random tie-break) over legal points
+        return _argmax_random_tiebreak((kills << 20) + (libs << 10), legal, rng)
 
 
 class OnePlyAgent(Agent):
     """1-ply lookahead over every packed tactical channel.
 
     Stronger than HeuristicAgent (71.5% head-to-head over 200 games,
-    seed 7, 11 truncated — RESULTS.md win-rate table; tests/test_arena.py
+    seed 7, 6 truncated — RESULTS.md win-rate table; tests/test_arena.py
     checks the vs-random floor): for each legal point it weighs, from the
     to-move player's perspective,
       * stones captured by playing there (P_KILLS, own channel),
@@ -142,13 +149,7 @@ class OnePlyAgent(Agent):
         score = (1000 * my_kills + 700 * opp_kills + 400 * ladders
                  + 12 * my_libs + 6 * opp_libs
                  - 900 * (my_libs <= 1))
-        score = np.where(legal, score, np.int64(np.iinfo(np.int64).min))
-        moves = np.full(n, -1, dtype=np.int64)
-        for i in range(n):
-            if legal[i].any():
-                best = score[i].max()
-                moves[i] = rng.choice(np.flatnonzero(score[i] == best))
-        return moves
+        return _argmax_random_tiebreak(score, legal, rng)
 
 
 class PolicyAgent(Agent):
