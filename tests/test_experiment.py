@@ -90,9 +90,13 @@ def test_train_smoke_loss_decreases(data_root, tmp_path):
 
 
 def test_steps_per_call_numerics_match_single_step(data_root, tmp_path):
-    """K chained steps in one lax.scan dispatch must produce exactly the
-    params K sequential single-step dispatches produce (same synchronous
-    sampling stream), so dispatch amortization is a pure perf knob."""
+    """K chained steps in one lax.scan dispatch must produce the params K
+    sequential single-step dispatches produce (same synchronous sampling
+    stream), so dispatch amortization is a pure perf knob. Equality is at
+    float32 precision, not bitwise: K=1 deliberately bypasses the scan
+    program (its CPU compile is pathological), and XLA fuses the scanned
+    and unscanned programs differently — measured divergence is one ulp
+    (~3e-8) per step."""
     import jax
 
     results = []
@@ -105,7 +109,7 @@ def test_steps_per_call_numerics_match_single_step(data_root, tmp_path):
     flat1 = jax.tree.leaves(results[0])
     flat5 = jax.tree.leaves(results[1])
     for a, b in zip(flat1, flat5):
-        np.testing.assert_array_equal(a, b)
+        np.testing.assert_allclose(a, b, atol=5e-6, rtol=1e-5)
 
 
 def test_resume_realigns_to_print_windows(data_root, tmp_path):
