@@ -50,6 +50,10 @@ def main(argv=None) -> None:
     ap.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
     args = ap.parse_args(argv)
 
+    from ..utils import honor_platform_env
+
+    honor_platform_env()
+
     exp = warm_restart(args.checkpoint, parse_overrides(args.set), args.num)
     print(f"warm restart {exp.id} from {args.checkpoint} at step {exp.step}")
     exp.run(args.iters)
