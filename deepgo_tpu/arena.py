@@ -135,21 +135,34 @@ class OnePlyAgent(Agent):
     name = "oneply"
 
     def select_moves(self, packed, players, legal, rng):
-        from .features import P_LADDERS
-
         legal = _no_own_eyes(packed, players, legal)
-        n = len(packed)
-        idx = np.arange(n)
-        mine, theirs = players - 1, 2 - players
-        my_kills = packed[idx, P_KILLS + mine].reshape(n, -1).astype(np.int64)
-        opp_kills = packed[idx, P_KILLS + theirs].reshape(n, -1).astype(np.int64)
-        my_libs = packed[idx, P_LIB_AFTER + mine].reshape(n, -1).astype(np.int64)
-        opp_libs = packed[idx, P_LIB_AFTER + theirs].reshape(n, -1).astype(np.int64)
-        ladders = packed[idx, P_LADDERS + mine].reshape(n, -1).astype(np.int64)
-        score = (1000 * my_kills + 700 * opp_kills + 400 * ladders
-                 + 12 * my_libs + 6 * opp_libs
-                 - 900 * (my_libs <= 1))
-        return _argmax_random_tiebreak(score, legal, rng)
+        return _argmax_random_tiebreak(_oneply_scores(packed, players)[0],
+                                       legal, rng)
+
+
+def _oneply_scores(packed: np.ndarray,
+                   players: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """OnePlyAgent's tactical evaluation as two (n, 361) int64 grids.
+
+    Returns ``(score, forcing)``: the full evaluation, and its
+    capture/save/ladder component alone — the part that identifies a
+    genuinely forcing move, free of the positional liberty terms (which
+    can reach hundreds next to a big group). Shared by OnePlyAgent
+    (argmax of ``score`` over all legal points) and PolicySearchAgent
+    (re-ranking of policy candidates; urgency from ``forcing``)."""
+    from .features import P_LADDERS
+
+    n = len(packed)
+    idx = np.arange(n)
+    mine, theirs = players - 1, 2 - players
+    my_kills = packed[idx, P_KILLS + mine].reshape(n, -1).astype(np.int64)
+    opp_kills = packed[idx, P_KILLS + theirs].reshape(n, -1).astype(np.int64)
+    my_libs = packed[idx, P_LIB_AFTER + mine].reshape(n, -1).astype(np.int64)
+    opp_libs = packed[idx, P_LIB_AFTER + theirs].reshape(n, -1).astype(np.int64)
+    ladders = packed[idx, P_LADDERS + mine].reshape(n, -1).astype(np.int64)
+    forcing = 1000 * my_kills + 700 * opp_kills + 400 * ladders
+    score = (forcing + 12 * my_libs + 6 * opp_libs - 900 * (my_libs <= 1))
+    return score, forcing
 
 
 class PolicyAgent(Agent):
@@ -168,16 +181,81 @@ class PolicyAgent(Agent):
         self.rank = rank
         self._predict = make_policy_fn(cfg, top_k=1)
 
-    def select_moves(self, packed, players, legal, rng):
+    def _legal_log_probs(self, packed, players, legal) -> np.ndarray:
+        """One batched forward -> log-probs with illegal points at -inf."""
         ranks = np.full(len(packed), self.rank, dtype=np.int32)
         logp = batched_log_probs(self._predict, self.params, packed, players,
                                  ranks)
-        logp = np.where(legal, logp, -np.inf)
+        return np.where(legal, logp, -np.inf)
+
+    def select_moves(self, packed, players, legal, rng):
+        logp = self._legal_log_probs(packed, players, legal)
         moves = np.full(len(packed), -1, dtype=np.int64)
         for i in range(len(packed)):
             moves[i] = select_from_log_probs(logp[i], self.temperature,
                                              self.pass_threshold, rng)
         return moves
+
+
+class PolicySearchAgent(PolicyAgent):
+    """Policy prior + 1-ply tactical re-ranking — the policy/search combine.
+
+    The trained net proposes, the tactical 1-ply evaluation disposes: the
+    policy's ``top_k`` highest-probability legal moves form the candidate
+    set, the OnePlyAgent score (``_oneply_scores``) ranks candidates, and
+    the policy probability breaks tactical ties (tactical tiers are
+    integers >= 1 apart; adding a probability in (0, 1] never reorders
+    distinct tiers). Two guards keep it honest:
+
+      * urgency override — any legal move whose FORCING component
+        (capture/save/ladder terms only, positional liberty terms
+        excluded) reaches ``urgent`` (default 400: a working ladder or
+        better) joins the candidate set even if the policy ranked it
+        outside the top k, so tactical blunders the net missed are never
+        dropped — and an urgent move also vetoes the pass rule below;
+      * pass rule — with no urgent move on the board, the agent passes
+        when the net's best eye-masked legal move falls below
+        ``pass_threshold`` (PolicyAgent's rule, evaluated after the
+        ``_no_own_eyes`` mask that baselines use).
+
+    The agent is deterministic given the position (argmax of tactical
+    score + policy probability); ``rng`` only breaks exact score ties,
+    so ``--temperature`` is rejected for ``search:`` specs rather than
+    silently ignored. This is the cheapest instance of the
+    policy-guides-search pattern the paper points at (arXiv:1412.6564
+    §Conclusion: the policy net as a search prior); one TPU forward plus
+    one vectorized host re-rank per ply, no tree.
+    """
+
+    def __init__(self, params, cfg, name: str = "policy-search",
+                 top_k: int = 8, urgent: int = 400, **kw):
+        if kw.get("temperature", 0.0):
+            raise ValueError("PolicySearchAgent is a deterministic "
+                             "re-ranker; temperature is not supported")
+        super().__init__(params, cfg, name=name, **kw)
+        self.top_k = top_k
+        self.urgent = urgent
+
+    def select_moves(self, packed, players, legal, rng):
+        legal = _no_own_eyes(packed, players, legal)
+        logp = self._legal_log_probs(packed, players, legal)
+        k = min(self.top_k, logp.shape[1])
+        # k-th largest log-prob per row; rows with < k legal moves get -inf,
+        # which admits every legal move — exactly the right degradation
+        kth = np.partition(logp, -k, axis=1)[:, -k][:, None]
+        tact, forcing = _oneply_scores(packed, players)
+        urgent = legal & (forcing >= self.urgent)
+        cand = (legal & (logp >= kth)) | urgent
+        # prob in (0, 1] breaks tactical ties without reordering integer
+        # tiers; sub-ulp rng noise breaks exact (tact, prob) ties uniformly
+        prob = np.exp(logp) + rng.random(logp.shape) * 1e-9
+        score = np.where(cand, tact.astype(np.float64) + prob, -np.inf)
+        moves = np.where(cand.any(axis=1), score.argmax(axis=1), -1)
+        # pass when the policy itself would (best legal move below the
+        # pass threshold) — unless something urgent is on the board
+        best_p = np.exp(logp.max(axis=1, initial=-np.inf))
+        do_pass = (best_p < self.pass_threshold) & ~urgent.any(axis=1)
+        return np.where(do_pass, -1, moves)
 
 
 def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
@@ -269,6 +347,14 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         _, params, cfg = load_policy(spec.split(":", 1)[1])
         return PolicyAgent(params, cfg, name="policy", temperature=temperature,
                            rank=rank)
+    if spec.startswith("search:"):
+        from .models.serving import load_policy
+
+        # --temperature deliberately NOT forwarded: it applies to sampling
+        # policy agents only (see the CLI help); the re-ranker stays
+        # deterministic even in a mixed policy-vs-search match
+        _, params, cfg = load_policy(spec.split(":", 1)[1])
+        return PolicySearchAgent(params, cfg, rank=rank)
     if spec.startswith("model:"):  # random-init policy, for smoke runs
         cfg = policy_cnn.CONFIGS[spec.split(":", 1)[1]]
         params = policy_cnn.init(jax.random.key(seed), cfg)
@@ -276,7 +362,8 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
                            temperature=temperature, rank=rank)
     raise ValueError(
         f"unknown agent spec {spec!r} "
-        "(use random | heuristic | oneply | checkpoint:PATH | model:NAME)")
+        "(use random | heuristic | oneply | checkpoint:PATH | search:PATH "
+        "| model:NAME)")
 
 
 def main(argv=None) -> None:
@@ -290,8 +377,10 @@ def main(argv=None) -> None:
     ap.add_argument("--max-moves", type=int, default=450)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--temperature", type=float, default=0.0,
-                    help="softmax sampling temperature for policy agents "
-                         "(0 = argmax; >0 diversifies policy-vs-policy games)")
+                    help="softmax sampling temperature for checkpoint:/model: "
+                         "policy agents (0 = argmax; >0 diversifies "
+                         "policy-vs-policy games); search: agents stay "
+                         "deterministic regardless")
     ap.add_argument("--rank", type=int, default=9,
                     help="dan rank fed to policy agents' rank planes; match "
                          "the training corpus (e.g. 8 for the synthetic "

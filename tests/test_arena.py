@@ -240,3 +240,107 @@ class TestArena:
                           arena.HeuristicAgent)
         with pytest.raises(ValueError):
             arena._make_agent("gnugo", 0)
+
+    def test_search_agent_urgency_override_takes_capture(self):
+        # Random-init net knows nothing; the capture at (0,1) scores
+        # tactically >= 1000 and must be admitted + chosen via the urgency
+        # override even when the policy's top-k misses it.
+        import jax
+
+        from deepgo_tpu.models import policy_cnn
+        from deepgo_tpu.selfplay import legal_mask, summarize_state
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        agent = arena.PolicySearchAgent(params, cfg, top_k=1)
+        g = arena.GameState()
+        play(g.stones, g.age, 0, 0, WHITE)
+        play(g.stones, g.age, 1, 0, BLACK)
+        g.player = 1
+        packed = summarize_state(g)[None]
+        players = np.array([1], dtype=np.int32)
+        legal = legal_mask(packed, players, [g])
+        move = agent.select_moves(packed, players, legal,
+                                  np.random.default_rng(0))[0]
+        assert move == 0 * 19 + 1
+
+    def test_search_agent_urgent_move_vetoes_pass(self):
+        # pass_threshold=2.0 is unsatisfiable (prob <= 1), so the policy
+        # rule alone would always pass — the urgent capture must still be
+        # played.
+        import jax
+
+        from deepgo_tpu.models import policy_cnn
+        from deepgo_tpu.selfplay import legal_mask, summarize_state
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        agent = arena.PolicySearchAgent(params, cfg, top_k=1,
+                                        pass_threshold=2.0)
+        g = arena.GameState()
+        play(g.stones, g.age, 0, 0, WHITE)
+        play(g.stones, g.age, 1, 0, BLACK)
+        g.player = 1
+        packed = summarize_state(g)[None]
+        players = np.array([1], dtype=np.int32)
+        legal = legal_mask(packed, players, [g])
+        rng = np.random.default_rng(0)
+        assert agent.select_moves(packed, players, legal, rng)[0] == 1
+        # and on an empty board (nothing urgent) the same threshold passes
+        g2 = arena.GameState()
+        packed2 = summarize_state(g2)[None]
+        legal2 = legal_mask(packed2, players, [g2])
+        assert agent.select_moves(packed2, players, legal2, rng)[0] == -1
+
+    def test_search_agent_rejects_temperature(self):
+        import jax
+
+        from deepgo_tpu.models import policy_cnn
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        with pytest.raises(ValueError):
+            arena.PolicySearchAgent(params, cfg, temperature=0.5)
+
+    def test_search_agent_liberty_terms_are_not_urgent(self):
+        # a long safe chain makes liberties-after exceed 400/12 next to it,
+        # but nothing on this board is forcing (no capture, save, or
+        # ladder): with an unsatisfiable pass threshold the agent must
+        # still pass — positional liberty terms alone must never trip the
+        # urgency veto
+        import jax
+
+        from deepgo_tpu.models import policy_cnn
+        from deepgo_tpu.selfplay import legal_mask, summarize_state
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        agent = arena.PolicySearchAgent(params, cfg, pass_threshold=2.0)
+        g = arena.GameState()
+        for y in range(19):
+            play(g.stones, g.age, 9, y, BLACK)
+        g.player = 1
+        packed = summarize_state(g)[None]
+        players = np.array([1], dtype=np.int32)
+        from deepgo_tpu.features import P_LIB_AFTER
+
+        libs = packed[0, P_LIB_AFTER].reshape(-1)
+        assert int(libs.max()) * 12 >= 400  # the board really has the hazard
+        legal = legal_mask(packed, players, [g])
+        rng = np.random.default_rng(0)
+        assert agent.select_moves(packed, players, legal, rng)[0] == -1
+
+    def test_search_agent_plays_full_games(self):
+        import jax
+
+        from deepgo_tpu.models import policy_cnn
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(1), cfg)
+        agent = arena.PolicySearchAgent(params, cfg)
+        games, scores, stats = arena.play_match(
+            agent, arena.RandomAgent(), n_games=2, max_moves=40, seed=5)
+        assert stats["games"] == 2
+        for g in games:
+            for move in g.moves:
+                assert 0 <= move.x < 19 and 0 <= move.y < 19

@@ -1,0 +1,65 @@
+# Shared helpers for the round-3 chip-work queues. Source from a script
+# whose cwd is the repo root:   . tools/r3_lib.sh
+#
+# tools/r3_tpu_queue.sh still carries inline copies of these because it
+# was already executing when this file was factored out (editing a
+# running bash script corrupts its lazy parse); fold it over to this lib
+# the next time it is touched while idle.
+
+# Real-compute canary: the relay can be in a state where claim probes
+# succeed but computation wedges, so gate every stage on an actual jitted
+# matmul round-trip. Returns nonzero if the chip is not answering.
+canary() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128))
+print('canary', float(jax.jit(lambda a: (a @ a).sum())(x)))" \
+    >/dev/null 2>&1
+}
+
+# supervise <log> <stall_s> <cmd...>: run cmd, kill it if <log> stops
+# growing for <stall_s> seconds (a wedge mid-stage otherwise burns the
+# stage's whole timeout). rc 97 = killed for stalling.
+supervise() {
+  local log=$1 stall=$2; shift 2
+  "$@" &
+  local pid=$! last=-1 same=0
+  while kill -0 $pid 2>/dev/null; do
+    sleep 30
+    local size=$(stat -c %s "$log" 2>/dev/null || echo 0)
+    if [ "$size" = "$last" ]; then
+      same=$((same + 30))
+      if [ $same -ge $stall ]; then
+        echo "supervise: killing stalled pid $pid (log $log frozen ${same}s)"
+        kill $pid 2>/dev/null; sleep 2; kill -9 $pid 2>/dev/null
+        pkill -9 -P $pid 2>/dev/null
+        return 97
+      fi
+    else
+      same=0; last=$size
+    fi
+  done
+  wait $pid
+}
+
+# newest checkpoint whose config name is $1 -> "path step" (empty if none)
+find_ckpt() {
+  NAME=$1 python - <<'PY'
+import os
+from deepgo_tpu.experiments.checkpoint import load_meta
+want = os.environ["NAME"]
+best = None
+for rid in os.listdir("runs"):
+    p = os.path.join("runs", rid, "checkpoint.npz")
+    if not os.path.exists(p):
+        continue
+    try:
+        m = load_meta(p)
+    except Exception:
+        continue
+    if m.get("config", {}).get("name") == want:
+        if best is None or m["step"] > best[1]:
+            best = (p, m["step"])
+print(f"{best[0]} {best[1]}" if best else "")
+PY
+}

@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-3 follow-up chip work, run AFTER tools/r3_tpu_queue.sh completes:
+#
+#   1. a 2,000-step winner-conditioned fine-tune of the converged flagship
+#      (the CPU-scale sweep found 2k steps is the strength sweet spot and
+#      4k regresses — the queue's finetune stage only keeps its final
+#      4k-step checkpoint, so the sweet spot needs its own run), and
+#   2. PolicySearchAgent (policy prior + 1-ply tactical re-rank) matches
+#      for the converged and fine-tuned flagships vs the scripted
+#      baselines.
+#
+# Same conventions as the main queue: idempotent stages, done-markers,
+# canary gate, stall supervision, one chip process at a time.
+set -u
+cd "$(dirname "$0")/.."
+. tools/r3_lib.sh
+mkdir -p runs/r3logs
+
+# match <spec> <opponent> <tag> [games]
+match() {
+  local spec=$1 opp=$2 tag=$3 games=${4:-200}
+  local mark=runs/r3logs/done_arena_$tag
+  [ -f "$mark" ] && { echo "arena $tag already done"; return 0; }
+  canary || { echo "canary failed; skipping $tag"; return 1; }
+  supervise runs/r3logs/search_arena.log 600 \
+    timeout 3600 python -u -m deepgo_tpu.arena \
+    --a "$spec" --b "$opp" --games "$games" --rank 8 --seed 11 \
+    >> runs/r3logs/search_arena.log 2>&1
+  local rc=$?
+  [ $rc -eq 0 ] && touch "$mark"
+  echo "arena $tag rc=$rc"
+  tail -2 runs/r3logs/search_arena.log
+}
+
+read -r BASE BASE_STEP <<< "$(find_ckpt converge-12L128)"
+[ -n "${BASE:-}" ] || { echo "no converge checkpoint; run the main queue first"; exit 1; }
+echo "converge checkpoint: $BASE (step $BASE_STEP)"
+
+# --- stage 1: 2k-step winner fine-tune (the sweep's sweet spot) ---
+FT2K_WANT=$((BASE_STEP + 2000))
+read -r FT2K FT2K_STEP <<< "$(find_ckpt ft-winner-2k)"
+if [ -z "${FT2K:-}" ] || [ "${FT2K_STEP:-0}" -lt "$FT2K_WANT" ]; then
+  canary || { echo "canary failed; skipping ft-2k"; exit 1; }
+  supervise runs/r3logs/ft2k.log 600 \
+    timeout 7200 python -u -m deepgo_tpu.experiments.repeated \
+    --checkpoint "$BASE" --iters 2000 --set \
+    name=ft-winner-2k scheme=winner rate=0.005 momentum=0.9 \
+    steps_per_call=20 print_interval=100 validation_interval=2000 \
+    validation_size=4096 \
+    >> runs/r3logs/ft2k.log 2>&1
+  echo "ft-2k rc=$?"
+  read -r FT2K FT2K_STEP <<< "$(find_ckpt ft-winner-2k)"
+fi
+echo "ft-winner-2k checkpoint: ${FT2K:-none} (step ${FT2K_STEP:-0})"
+
+# --- stage 2: matches ---
+match "search:$BASE" oneply search_base_oneply
+# only match a COMPLETE fine-tune: a partial checkpoint (relay died
+# mid-stage) would otherwise be done-marked as the real ft-winner-2k
+if [ -z "${FT2K:-}" ] || [ "${FT2K_STEP:-0}" -lt "$FT2K_WANT" ]; then
+  echo "ft-winner-2k incomplete (${FT2K_STEP:-0} < $FT2K_WANT); rerun to finish"
+  exit 1
+fi
+match "checkpoint:$FT2K" oneply ft2k_oneply
+match "checkpoint:$FT2K" heuristic ft2k_heuristic
+match "search:$FT2K" oneply search_ft2k_oneply
+match "search:$FT2K" heuristic search_ft2k_heuristic
+echo "=== search arena done [$(date -u +%H:%M:%S)] ==="

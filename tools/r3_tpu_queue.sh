@@ -59,7 +59,7 @@ run_curve() {
   supervise runs/r3logs/curve.log 600 \
     timeout 7200 python -u tools/accuracy_curve.py \
     --data-root $CORPUS \
-    --budgets 4000,40000,400000,3294221 --iters 4000 \
+    --budgets 4000,40000,400000,3288963 --iters 4000 \
     --out docs/accuracy_curve.jsonl \
     --set num_layers=12 channels=128 batch_size=512 \
     >> runs/r3logs/curve.log 2>&1
