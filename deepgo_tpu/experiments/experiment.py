@@ -238,6 +238,14 @@ class Experiment:
         # vs 3.4s unscanned, 3L/64 batch 256), and a 1-step scan buys no
         # dispatch amortization anywhere
         use_scan = k_steps > 1
+        if use_scan and jax.default_backend() == "cpu":
+            # the fused-scan program is the TPU dispatch-amortization win;
+            # XLA CPU executes scanned convs ~100x slower than the same
+            # convs dispatched singly (measured 3 vs 390 samples/sec,
+            # 3L/64 batch 256) — flag it rather than silently crawling
+            print(f"warning: steps_per_call={k_steps} on the CPU backend "
+                  "runs the scanned train step, which XLA CPU executes "
+                  "~100x slower than steps_per_call=1", flush=True)
         ewma = None
         last_loss = float("nan")
         last_val: dict = {}

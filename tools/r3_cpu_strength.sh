@@ -1,0 +1,79 @@
+#!/bin/bash
+# Chip-independent strength-axis pipeline at CPU scale (3L/64): rebuilds
+# the round-3 CPU checkpoints (the runs/ tree is machine-local and does
+# not survive a driver restart) and adds PolicySearchAgent matches.
+#
+#   base:    3L/64 on the full synthetic corpus, uniform sampling
+#   ft2k:    +2,000 winner-conditioned fine-tune steps (the sweep's
+#            strength sweet spot; see RESULTS.md)
+#   matches: ft2k and search:{base,ft2k} vs the scripted baselines
+#
+# Everything runs under JAX_PLATFORMS=cpu (never dials the TPU relay) and
+# nice -n 10 (yields the single host core to any live chip work). Stages
+# are idempotent via find_ckpt / done-markers, same as the main queue.
+set -u
+cd "$(dirname "$0")/.."
+. tools/r3_lib.sh
+mkdir -p runs/r3logs
+export JAX_PLATFORMS=cpu
+CORPUS=data/corpus/processed
+N=${NICE:-10}
+
+read -r BASE BASE_STEP <<< "$(find_ckpt cpu-base)"
+if [ -z "${BASE:-}" ] || [ "${BASE_STEP:-0}" -lt 1500 ]; then
+  echo "=== cpu-base train [$(date -u +%H:%M:%S)] ==="
+  nice -n $N timeout 7200 python -u -m deepgo_tpu.cli train --iters 1500 --set \
+    name=cpu-base data_root=$CORPUS scheme=uniform batch_size=256 \
+    steps_per_call=1 validation_interval=1500 validation_size=2048 \
+    print_interval=50 \
+    >> runs/r3logs/cpu_base.log 2>&1
+  echo "cpu-base rc=$?"
+  read -r BASE BASE_STEP <<< "$(find_ckpt cpu-base)"
+fi
+[ -n "${BASE:-}" ] || { echo "no cpu-base checkpoint"; exit 1; }
+echo "cpu-base: $BASE (step $BASE_STEP)"
+
+for s in train validation; do
+  [ -f $CORPUS/$s/winner.npy ] || nice -n $N timeout 1800 python \
+    tools/winner_index.py --processed $CORPUS/$s --sgf data/corpus/sgf/$s \
+    >> runs/r3logs/cpu_ft2k.log 2>&1
+done
+
+FT_WANT=$((BASE_STEP + 2000))
+read -r FT FT_STEP <<< "$(find_ckpt cpu-ft2k)"
+if [ -z "${FT:-}" ] || [ "${FT_STEP:-0}" -lt "$FT_WANT" ]; then
+  echo "=== cpu-ft2k fine-tune [$(date -u +%H:%M:%S)] ==="
+  nice -n $N timeout 10800 python -u -m deepgo_tpu.experiments.repeated \
+    --checkpoint "$BASE" --iters 2000 --set \
+    name=cpu-ft2k scheme=winner rate=0.005 momentum=0.9 steps_per_call=1 \
+    print_interval=50 validation_interval=2000 validation_size=2048 \
+    >> runs/r3logs/cpu_ft2k.log 2>&1
+  echo "cpu-ft2k rc=$?"
+  read -r FT FT_STEP <<< "$(find_ckpt cpu-ft2k)"
+fi
+if [ -z "${FT:-}" ] || [ "${FT_STEP:-0}" -lt "$FT_WANT" ]; then
+  echo "cpu-ft2k incomplete (${FT_STEP:-0} < $FT_WANT); rerun to finish"
+  exit 1
+fi
+echo "cpu-ft2k: $FT (step $FT_STEP)"
+
+# cpu_match <spec> <opponent> <tag>
+cpu_match() {
+  local spec=$1 opp=$2 tag=$3
+  local mark=runs/r3logs/done_cpu_arena_$tag
+  [ -f "$mark" ] && { echo "cpu arena $tag already done"; return 0; }
+  echo "=== cpu arena $tag [$(date -u +%H:%M:%S)] ==="
+  nice -n $N timeout 7200 python -u -m deepgo_tpu.arena \
+    --a "$spec" --b "$opp" --games 200 --rank 8 --seed 11 \
+    >> runs/r3logs/cpu_arena.log 2>&1
+  local rc=$?
+  [ $rc -eq 0 ] && touch "$mark"
+  echo "cpu arena $tag rc=$rc"
+  tail -1 runs/r3logs/cpu_arena.log
+}
+
+cpu_match "checkpoint:$FT" oneply cpu_ft2k_oneply
+cpu_match "search:$FT" oneply cpu_search_ft2k_oneply
+cpu_match "search:$BASE" oneply cpu_search_base_oneply
+cpu_match "search:$FT" heuristic cpu_search_ft2k_heuristic
+echo "=== cpu strength pipeline done [$(date -u +%H:%M:%S)] ==="
