@@ -25,6 +25,9 @@ Extra modes (round-2 verdict items 2 and 5), each also one JSON line:
                    fetch round trip, so through the axon relay the numbers
                    include tunnel RTT — an upper bound on on-host serving
                    latency (stated in the JSON).
+  --mode large     13L/256 (AlphaGo SL-policy scale) training step, remat
+                   on vs off: samples/sec + device memory high-water
+                   (round-2 verdict item 4 — the HBM-vs-FLOPs trade).
 """
 
 from __future__ import annotations
@@ -44,6 +47,7 @@ _METRIC_OF = {
     "inference": ("policy_inference_boards_per_sec_per_chip", "boards/sec"),
     "train": ("fused_training_samples_per_sec_per_chip", "samples/sec"),
     "latency": ("policy_inference_latency_ms", "ms p50 (includes relay RTT)"),
+    "large": ("large_training_samples_per_sec_per_chip", "samples/sec"),
 }
 
 
@@ -97,7 +101,12 @@ def _preflight_probe(mode: str = "inference") -> None:
     if os.environ.get("BENCH_PREFLIGHT") == "0":
         return
     timeout_s = float(os.environ.get("BENCH_PREFLIGHT_S", "60"))
-    code = "import jax; print(jax.devices()[0].platform, flush=True)"
+    # the probe must dial the same backend the benchmark will use, so it
+    # re-asserts JAX_PLATFORMS exactly like honor_platform_env (the
+    # terminal's sitecustomize overrides the env var at interpreter start)
+    code = ("import os, jax; w = os.environ.get('JAX_PLATFORMS'); "
+            "w and jax.config.update('jax_platforms', w); "
+            "print(jax.devices()[0].platform, flush=True)")
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=timeout_s)
@@ -128,14 +137,60 @@ def _rand_batch(rng, shape_prefix) -> tuple:
     )
 
 
-def _bench_train(on_tpu: bool) -> dict:
-    """Fused-training samples/sec: K chained optimizer steps per dispatch
-    (make_train_step_many), one scalar fetch to fence the measurement."""
+def _time_train_step(cfg, batch: int, k_steps: int, repeats: int,
+                     rng) -> tuple[float, float]:
+    """Median-timed fused train step -> (samples_per_sec, ms_per_step).
+
+    ``k_steps > 0`` times the K-step scan program (make_train_step_many,
+    one dispatch, one scalar fetch to fence); ``k_steps = 0`` times the
+    single-dispatch step — the CPU path, where XLA executes scanned conv
+    steps pathologically slowly (see Experiment._train's warning). Shared
+    by --mode train and --mode large so the fencing/timing methodology
+    cannot diverge between them."""
     import jax
 
     from deepgo_tpu.models import policy_cnn
-    from deepgo_tpu.training import make_train_step_many
+    from deepgo_tpu.training import make_train_step, make_train_step_many
     from deepgo_tpu.training.optimizers import OPTIMIZERS
+
+    optimizer = OPTIMIZERS["sgd"](0.01, 1e-7, 0.0)
+    params = policy_cnn.init(jax.random.key(0), cfg)
+    opt_state = optimizer.init(params)
+    if k_steps:
+        step = make_train_step_many(cfg, optimizer)
+        prefix = (k_steps, batch)
+    else:
+        step = make_train_step(cfg, optimizer)
+        prefix = (batch,)
+    packed, player, rank = _rand_batch(rng, prefix)
+    superbatch = {
+        "packed": jax.device_put(packed),
+        "player": jax.device_put(player),
+        "rank": jax.device_put(rank),
+        "target": jax.device_put(
+            rng.integers(0, 361, size=prefix).astype(np.int32)),
+    }
+
+    def fence(losses) -> float:  # all steps must have executed
+        return float(np.atleast_1d(np.asarray(losses))[-1])
+
+    params, opt_state, losses = step(params, opt_state, superbatch)
+    assert np.isfinite(fence(losses)), "non-finite training loss"
+    times = []
+    for _ in range(repeats):
+        t0 = time.time()
+        params, opt_state, losses = step(params, opt_state, superbatch)
+        fence(losses)
+        times.append(time.time() - t0)
+    dt = float(np.median(times))
+    per_call = max(1, k_steps)
+    return per_call * batch / dt, 1000 * dt / per_call
+
+
+def _bench_train(on_tpu: bool) -> dict:
+    """Fused-training samples/sec: K chained optimizer steps per dispatch
+    (make_train_step_many), one scalar fetch to fence the measurement."""
+    from deepgo_tpu.models import policy_cnn
 
     rng = np.random.default_rng(0)
     configs = [("3L/64", "small"), ("12L/128", "full")]
@@ -143,31 +198,10 @@ def _bench_train(on_tpu: bool) -> dict:
     out = {}
     for label, name in configs:
         cfg = policy_cnn.CONFIGS[name]
-        optimizer = OPTIMIZERS["sgd"](0.01, 1e-7, 0.0)
-        params = policy_cnn.init(jax.random.key(0), cfg)
-        opt_state = optimizer.init(params)
-        step = make_train_step_many(cfg, optimizer)
-        packed, player, rank = _rand_batch(rng, (k_steps, batch))
-        superbatch = {
-            "packed": jax.device_put(packed),
-            "player": jax.device_put(player),
-            "rank": jax.device_put(rank),
-            "target": jax.device_put(
-                rng.integers(0, 361, size=(k_steps, batch)).astype(np.int32)),
-        }
-        params, opt_state, losses = step(params, opt_state, superbatch)
-        assert np.isfinite(float(losses[-1])), "non-finite training loss"
-        times = []
-        for _ in range(repeats):
-            t0 = time.time()
-            params, opt_state, losses = step(params, opt_state, superbatch)
-            float(losses[-1])  # fence: all K steps must have executed
-            times.append(time.time() - t0)
-        dt = float(np.median(times))
-        sps = k_steps * batch / dt
+        sps, ms_per_step = _time_train_step(cfg, batch, k_steps, repeats, rng)
         out[label] = {
             "samples_per_sec": round(sps, 1),
-            "ms_per_step": round(1000 * dt / k_steps, 3),
+            "ms_per_step": round(ms_per_step, 3),
         }
         # fwd + bwd ~= 3x forward FLOPs (standard estimate)
         out[label]["tflops_est"] = round(
@@ -186,6 +220,68 @@ def _bench_train(on_tpu: bool) -> dict:
         "batch": batch,
         "steps_per_call": k_steps,
         "configs": out,
+    }
+
+
+def _peak_mem_mb():
+    """Device allocator high-water in MiB, when the backend exposes it
+    (PJRT memory_stats; absent on some backends — then None)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        return round(peak / 2**20, 1) if peak else None
+    except Exception:
+        return None
+
+
+def _bench_large(on_tpu: bool) -> dict:
+    """13L/256 ("large", the AlphaGo SL-policy scale config) training step
+    with rematerialization on vs off: samples/sec plus the device memory
+    high-water — the HBM-vs-FLOPs trade measured rather than asserted.
+
+    remat=True runs FIRST: the allocator's peak_bytes_in_use is a process
+    high-water with no reset API, so the first reading is the remat peak
+    and any rise after the remat=False run is attributable to keeping
+    activations alive."""
+    import dataclasses
+
+    from deepgo_tpu.models import policy_cnn
+
+    rng = np.random.default_rng(0)
+    # CPU smoke uses a single-dispatch step: XLA CPU executes scanned conv
+    # steps pathologically slowly (see Experiment._train warning)
+    batch, k_steps, repeats = (4096, 4, 2) if on_tpu else (16, 0, 1)
+    out = {}
+    for remat in (True, False):
+        cfg = dataclasses.replace(policy_cnn.CONFIGS["large"], remat=remat)
+        key = f"remat_{str(remat).lower()}"
+        # one setting OOMing (the very trade this probes — remat=False at
+        # big batch sits near a v5e's HBM) must not discard the other
+        # setting's numbers or the one-JSON-line driver contract
+        try:
+            sps, ms_per_step = _time_train_step(cfg, batch, k_steps,
+                                                repeats, rng)
+            out[key] = {
+                "samples_per_sec": round(sps, 1),
+                "ms_per_step": round(ms_per_step, 3),
+                "peak_mem_mb_cumulative": _peak_mem_mb(),
+            }
+        except Exception as e:  # RESOURCE_EXHAUSTED and kin
+            out[key] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}",
+                "peak_mem_mb_cumulative": _peak_mem_mb(),
+            }
+    return {
+        "metric": "large_training_samples_per_sec_per_chip",
+        "value": out["remat_false"].get("samples_per_sec", 0.0),
+        "unit": "samples/sec",
+        "vs_baseline": None,
+        "batch": batch,
+        "steps_per_call": k_steps,
+        "config": "13L/256",
+        "settings": out,
     }
 
 
@@ -242,11 +338,17 @@ def main() -> None:
 
     ap = argparse.ArgumentParser(description="deepgo_tpu benchmarks")
     ap.add_argument("--mode", default="inference",
-                    choices=["inference", "train", "latency"])
+                    choices=["inference", "train", "latency", "large"])
     args = ap.parse_args()
 
     _preflight_probe(args.mode)
     watchdog = _arm_watchdog(args.mode)
+    # honor JAX_PLATFORMS (e.g. a CPU smoke run) against the terminal
+    # sitecustomize's override — without this a CPU-pinned bench still
+    # dials the TPU relay and blocks forever when the relay is down
+    from deepgo_tpu.utils import honor_platform_env
+
+    honor_platform_env()
     import jax
     import jax.numpy as jnp
 
@@ -257,7 +359,9 @@ def main() -> None:
     on_tpu = device.platform != "cpu"
 
     if args.mode != "inference":
-        result = (_bench_train if args.mode == "train" else _bench_latency)(on_tpu)
+        fn = {"train": _bench_train, "latency": _bench_latency,
+              "large": _bench_large}[args.mode]
+        result = fn(on_tpu)
         result["device"] = str(device)
         watchdog.disarm()
         print(json.dumps(result))

@@ -207,7 +207,7 @@ run_selfplay() {
 
 run_bench() {
   stage bench
-  for mode in inference train latency; do
+  for mode in inference train latency large; do
     if [ -s runs/r3logs/bench_$mode.json ] \
         && ! grep -q '"error"' runs/r3logs/bench_$mode.json; then
       echo "bench $mode already done"; continue
