@@ -273,9 +273,13 @@ def _bench_large(on_tpu: bool) -> dict:
                 "error": f"{type(e).__name__}: {str(e)[:300]}",
                 "peak_mem_mb_cumulative": _peak_mem_mb(),
             }
+    # headline prefers the remat=False number, falls back to remat=True if
+    # only that setting fit (one OOMing is a valid measured outcome here)
+    value = out["remat_false"].get(
+        "samples_per_sec", out["remat_true"].get("samples_per_sec", 0.0))
     return {
         "metric": "large_training_samples_per_sec_per_chip",
-        "value": out["remat_false"].get("samples_per_sec", 0.0),
+        "value": value,
         "unit": "samples/sec",
         "vs_baseline": None,
         "batch": batch,

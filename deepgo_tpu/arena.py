@@ -198,33 +198,34 @@ class PolicyAgent(Agent):
 
 
 class PolicySearchAgent(PolicyAgent):
-    """Policy prior + 1-ply tactical re-ranking — the policy/search combine.
+    """Policy move with a tactical veto — the policy/search combine.
 
-    The trained net proposes, the tactical 1-ply evaluation disposes: the
-    policy's ``top_k`` highest-probability legal moves form the candidate
-    set, the OnePlyAgent score (``_oneply_scores``) ranks candidates, and
-    the policy probability breaks tactical ties (tactical tiers are
-    integers >= 1 apart; adding a probability in (0, 1] never reorders
-    distinct tiers). Two guards keep it honest:
+    On a quiet board the agent plays the net's argmax move unchanged. Only
+    when a FORCING move exists — the capture/save/ladder component of the
+    1-ply evaluation (``_oneply_scores``, positional liberty terms
+    excluded) reaches ``urgent`` (default 400: a working ladder or
+    better) — does the tactical evaluation take over: the forcing moves
+    plus the policy's ``top_k`` candidates are re-ranked by tactical
+    score, with the policy probability as tie-break (tactical tiers are
+    integers >= 1 apart; a probability in (0, 1] never reorders distinct
+    tiers). A live forcing move also vetoes the pass rule; otherwise the
+    agent passes exactly when the net's best eye-masked legal move falls
+    below ``pass_threshold``.
 
-      * urgency override — any legal move whose FORCING component
-        (capture/save/ladder terms only, positional liberty terms
-        excluded) reaches ``urgent`` (default 400: a working ladder or
-        better) joins the candidate set even if the policy ranked it
-        outside the top k, so tactical blunders the net missed are never
-        dropped — and an urgent move also vetoes the pass rule below;
-      * pass rule — with no urgent move on the board, the agent passes
-        when the net's best eye-masked legal move falls below
-        ``pass_threshold`` (PolicyAgent's rule, evaluated after the
-        ``_no_own_eyes`` mask that baselines use).
+    Deferring to tactics ONLY on forcing boards is load-bearing:
+    re-ranking every move imposes the 1-ply searcher's own style and
+    drags a policy that already beats it back toward its level (measured
+    60.5% -> 51.0% vs oneply for the winner-fine-tuned net), while the
+    veto design preserves the policy's play and only patches its
+    blunders (60.5% -> 65.0%; and it lifts a weak pure imitator from
+    2.5% -> 50.0% — RESULTS.md win-rate tables).
 
-    The agent is deterministic given the position (argmax of tactical
-    score + policy probability); ``rng`` only breaks exact score ties,
-    so ``--temperature`` is rejected for ``search:`` specs rather than
-    silently ignored. This is the cheapest instance of the
-    policy-guides-search pattern the paper points at (arXiv:1412.6564
-    §Conclusion: the policy net as a search prior); one TPU forward plus
-    one vectorized host re-rank per ply, no tree.
+    The agent is deterministic given the position; ``rng`` only breaks
+    exact score ties, so ``--temperature`` is rejected for ``search:``
+    specs rather than silently ignored. This is the cheapest instance of
+    the policy-guides-search pattern the paper points at
+    (arXiv:1412.6564 §Conclusion: the policy net as a search prior); one
+    TPU forward plus one vectorized host check per ply, no tree.
     """
 
     def __init__(self, params, cfg, name: str = "policy-search",
@@ -239,22 +240,25 @@ class PolicySearchAgent(PolicyAgent):
     def select_moves(self, packed, players, legal, rng):
         legal = _no_own_eyes(packed, players, legal)
         logp = self._legal_log_probs(packed, players, legal)
+        tact, forcing = _oneply_scores(packed, players)
+        urgent = legal & (forcing >= self.urgent)
+        has_urgent = urgent.any(axis=1)
         k = min(self.top_k, logp.shape[1])
         # k-th largest log-prob per row; rows with < k legal moves get -inf,
         # which admits every legal move — exactly the right degradation
         kth = np.partition(logp, -k, axis=1)[:, -k][:, None]
-        tact, forcing = _oneply_scores(packed, players)
-        urgent = legal & (forcing >= self.urgent)
         cand = (legal & (logp >= kth)) | urgent
         # prob in (0, 1] breaks tactical ties without reordering integer
         # tiers; sub-ulp rng noise breaks exact (tact, prob) ties uniformly
         prob = np.exp(logp) + rng.random(logp.shape) * 1e-9
         score = np.where(cand, tact.astype(np.float64) + prob, -np.inf)
-        moves = np.where(cand.any(axis=1), score.argmax(axis=1), -1)
+        rerank = np.where(cand.any(axis=1), score.argmax(axis=1), -1)
+        policy = np.where(legal.any(axis=1), logp.argmax(axis=1), -1)
+        moves = np.where(has_urgent, rerank, policy)
         # pass when the policy itself would (best legal move below the
-        # pass threshold) — unless something urgent is on the board
+        # pass threshold) — unless something forcing is on the board
         best_p = np.exp(logp.max(axis=1, initial=-np.inf))
-        do_pass = (best_p < self.pass_threshold) & ~urgent.any(axis=1)
+        do_pass = (best_p < self.pass_threshold) & ~has_urgent
         return np.where(do_pass, -1, moves)
 
 

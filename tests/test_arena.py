@@ -330,6 +330,32 @@ class TestArena:
         rng = np.random.default_rng(0)
         assert agent.select_moves(packed, players, legal, rng)[0] == -1
 
+    def test_search_agent_quiet_board_plays_policy_argmax(self):
+        # no forcing move on the board -> the agent must play exactly the
+        # net's (eye-masked) argmax move, not a tactically re-ranked one
+        import jax
+
+        from deepgo_tpu.models import policy_cnn
+        from deepgo_tpu.selfplay import (batched_log_probs, legal_mask,
+                                         summarize_state)
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(2), cfg)
+        agent = arena.PolicySearchAgent(params, cfg)
+        g = arena.GameState()
+        play(g.stones, g.age, 3, 3, BLACK)
+        play(g.stones, g.age, 15, 15, WHITE)
+        packed = summarize_state(g)[None]
+        players = np.array([1], dtype=np.int32)
+        legal = legal_mask(packed, players, [g])
+        move = agent.select_moves(packed, players, legal,
+                                  np.random.default_rng(0))[0]
+        masked = arena._no_own_eyes(packed, players, legal)
+        logp = batched_log_probs(agent._predict, params, packed, players,
+                                 np.array([9], dtype=np.int32))
+        expect = int(np.where(masked[0], logp[0], -np.inf).argmax())
+        assert move == expect
+
     def test_search_agent_plays_full_games(self):
         import jax
 

@@ -208,8 +208,16 @@ run_selfplay() {
 run_bench() {
   stage bench
   for mode in inference train latency large; do
-    if [ -s runs/r3logs/bench_$mode.json ] \
-        && ! grep -q '"error"' runs/r3logs/bench_$mode.json; then
+    # done = parseable JSON with no TOP-LEVEL error key. A per-setting
+    # error inside "settings" (e.g. --mode large's remat=false OOMing at
+    # big batch) is a valid measured outcome, not a retry trigger.
+    if [ -s runs/r3logs/bench_$mode.json ] && python - <<PY
+import json, sys
+with open("runs/r3logs/bench_$mode.json") as f:
+    d = json.loads(f.read().strip().splitlines()[-1])
+sys.exit(1 if "error" in d else 0)
+PY
+    then
       echo "bench $mode already done"; continue
     fi
     canary || { echo "canary failed; skipping bench $mode"; return 1; }
