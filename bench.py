@@ -276,7 +276,18 @@ def _bench_large(on_tpu: bool) -> dict:
     # headline prefers the remat=False number, falls back to remat=True if
     # only that setting fit (one OOMing is a valid measured outcome here)
     value = out["remat_false"].get(
-        "samples_per_sec", out["remat_true"].get("samples_per_sec", 0.0))
+        "samples_per_sec", out["remat_true"].get("samples_per_sec"))
+    if value is None:
+        # BOTH settings failing is not a measurement — surface a top-level
+        # error so retry logic (r3_tpu_queue.sh done-check) sees it
+        return {
+            "metric": "large_training_samples_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "samples/sec",
+            "vs_baseline": None,
+            "error": "both remat settings failed",
+            "settings": out,
+        }
     return {
         "metric": "large_training_samples_per_sec_per_chip",
         "value": value,

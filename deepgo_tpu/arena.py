@@ -217,8 +217,9 @@ class PolicySearchAgent(PolicyAgent):
     drags a policy that already beats it back toward its level (measured
     60.5% -> 51.0% vs oneply for the winner-fine-tuned net), while the
     veto design preserves the policy's play and only patches its
-    blunders (60.5% -> 65.0%; and it lifts a weak pure imitator from
-    2.5% -> 50.0% — RESULTS.md win-rate tables).
+    blunders (60.5% -> 69.5%; and it lifts a weak pure imitator from
+    2.5% -> 45.5% — RESULTS.md win-rate tables, which also state the
+    ±~4-point tie-break/binomial noise at 200 games).
 
     The agent is deterministic given the position; ``rng`` only breaks
     exact score ties, so ``--temperature`` is rejected for ``search:``
@@ -243,18 +244,22 @@ class PolicySearchAgent(PolicyAgent):
         tact, forcing = _oneply_scores(packed, players)
         urgent = legal & (forcing >= self.urgent)
         has_urgent = urgent.any(axis=1)
-        k = min(self.top_k, logp.shape[1])
-        # k-th largest log-prob per row; rows with < k legal moves get -inf,
-        # which admits every legal move — exactly the right degradation
-        kth = np.partition(logp, -k, axis=1)[:, -k][:, None]
-        cand = (legal & (logp >= kth)) | urgent
-        # prob in (0, 1] breaks tactical ties without reordering integer
-        # tiers; sub-ulp rng noise breaks exact (tact, prob) ties uniformly
-        prob = np.exp(logp) + rng.random(logp.shape) * 1e-9
-        score = np.where(cand, tact.astype(np.float64) + prob, -np.inf)
-        rerank = np.where(cand.any(axis=1), score.argmax(axis=1), -1)
-        policy = np.where(legal.any(axis=1), logp.argmax(axis=1), -1)
-        moves = np.where(has_urgent, rerank, policy)
+        moves = np.where(legal.any(axis=1), logp.argmax(axis=1), -1)
+        if has_urgent.any():
+            # re-rank only the rows with a live forcing move — most Go
+            # positions are quiet, so the partition/exp work is skipped
+            # for the typical all-quiet ply
+            k = min(self.top_k, logp.shape[1])
+            # k-th largest log-prob per row; rows with < k legal moves get
+            # -inf, which admits every legal move — the right degradation
+            kth = np.partition(logp, -k, axis=1)[:, -k][:, None]
+            cand = (legal & (logp >= kth)) | urgent
+            # prob in (0, 1] breaks tactical ties without reordering
+            # integer tiers; sub-ulp rng noise breaks exact ties uniformly
+            prob = np.exp(logp) + rng.random(logp.shape) * 1e-9
+            score = np.where(cand, tact.astype(np.float64) + prob, -np.inf)
+            rerank = np.where(cand.any(axis=1), score.argmax(axis=1), -1)
+            moves = np.where(has_urgent, rerank, moves)
         # pass when the policy itself would (best legal move below the
         # pass threshold) — unless something forcing is on the board
         best_p = np.exp(logp.max(axis=1, initial=-np.inf))

@@ -1,0 +1,111 @@
+"""Generate a ranked corpus from arbitrary agent pairings (self-play loop).
+
+Where tools/make_corpus.py fixes the scripted-baseline pairings, this one
+takes agent SPECS (arena._make_agent syntax: oneply | heuristic | random |
+checkpoint:PATH | search:PATH | model:NAME) so a TRAINED policy can
+generate its own next training corpus — the data side of the
+imitation -> outcome-conditioned -> self-play improvement loop. Games are
+written as SGFs with the given dan-rank tags and split train/validation/
+test by game id exactly like make_corpus, then transcribed through the
+same shard pipeline (reference pipeline anchors: makedata.lua:517-576).
+
+Usage:
+  python tools/make_selfplay_corpus.py --out data/iter1 \
+      --pairs "checkpoint:runs/X/checkpoint.npz,oneply" \
+              "checkpoint:runs/X/checkpoint.npz,checkpoint:runs/X/checkpoint.npz" \
+      --games 2048 --temperature 0.25 --rank 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepgo_tpu import arena  # noqa: E402
+from deepgo_tpu.selfplay import to_sgf  # noqa: E402
+from tools.make_corpus import split_of  # noqa: E402
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--pairs", nargs="+", required=True,
+                    help="comma-separated agent-spec pairs, cycled per chunk")
+    ap.add_argument("--games", type=int, default=2048)
+    ap.add_argument("--chunk", type=int, default=512)
+    ap.add_argument("--max-moves", type=int, default=350)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--temperature", type=float, default=0.25,
+                    help="sampling temperature for checkpoint:/model: agents "
+                         "(diversifies otherwise-deterministic games)")
+    ap.add_argument("--rank", type=int, default=8,
+                    help="dan-rank tag for policy agents (baselines keep "
+                         "their make_corpus tags: oneply=8, heuristic=4)")
+    ap.add_argument("--skip-transcribe", action="store_true")
+    args = ap.parse_args(argv)
+
+    from deepgo_tpu.utils import honor_platform_env
+
+    honor_platform_env()
+
+    baseline_rank = {"oneply": 8, "heuristic": 4, "random": 1}
+    pairs = [tuple(p.split(",")) for p in args.pairs]
+    assert all(len(p) == 2 for p in pairs), "each --pairs entry is 'specA,specB'"
+    agents: dict[str, arena.Agent] = {}
+    for spec in {s for p in pairs for s in p}:
+        temp = 0.0 if spec in baseline_rank or spec.startswith("search:") \
+            else args.temperature
+        agents[spec] = arena._make_agent(spec, args.seed, temp, args.rank)
+
+    def rank_of(spec: str) -> int:
+        return baseline_rank.get(spec, args.rank)
+
+    for split in ("train", "validation", "test"):
+        os.makedirs(os.path.join(args.out, "sgf", split), exist_ok=True)
+
+    totals = {"games": 0, "positions": 0, "truncated": 0}
+    t0 = time.time()
+    round_idx = 0
+    while totals["games"] < args.games:
+        spec_a, spec_b = pairs[round_idx % len(pairs)]
+        n = min(args.chunk, args.games - totals["games"])
+        games, scores, stats = arena.play_match(
+            agents[spec_a], agents[spec_b], n_games=n,
+            max_moves=args.max_moves, seed=args.seed + round_idx)
+        totals["truncated"] += stats["truncated"]
+        for i, (g, s) in enumerate(zip(games, scores)):
+            gid = totals["games"]
+            totals["games"] += 1
+            totals["positions"] += len(g.moves)
+            black, white = (spec_a, spec_b) if i % 2 == 0 else (spec_b, spec_a)
+            done = g.passes >= 2
+            path = os.path.join(args.out, "sgf", split_of(gid),
+                                f"g{gid:07d}.sgf")
+            with open(path, "w") as f:
+                f.write(to_sgf(
+                    g, black_rank=rank_of(black), white_rank=rank_of(white),
+                    result=s.result_string() if done else None, komi=7.5))
+        round_idx += 1
+        rate = totals["positions"] / (time.time() - t0)
+        print(f"{totals['games']:,}/{args.games:,} games "
+              f"({totals['positions']:,} positions, {rate:,.0f} pos/sec)",
+              flush=True)
+    print(totals)
+
+    if not args.skip_transcribe:
+        from deepgo_tpu.data.transcribe import transcribe_split
+
+        for split in ("train", "validation", "test"):
+            n = transcribe_split(
+                os.path.join(args.out, "sgf", split),
+                os.path.join(args.out, "processed", split),
+                workers=max(1, (os.cpu_count() or 2) - 1), verbose=False)
+            print(f"transcribed {split}: {n:,} examples", flush=True)
+
+
+if __name__ == "__main__":
+    main()
