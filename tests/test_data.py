@@ -168,3 +168,26 @@ def test_winner_scheme_samples_only_winner_moves(tmp_path):
                      prefetch=2) as loader:
         batch = loader.get(stack=0)
     assert batch["packed"].shape[0] == 8
+
+
+def test_make_selfplay_corpus_end_to_end(tmp_path):
+    """Agent-spec corpus generator: games -> split SGFs -> shards, ranks
+    tagged per agent, decided games carry RE[] for the winner sidecar."""
+    import make_selfplay_corpus
+    from winner_index import build
+
+    out = tmp_path / "corpus"
+    make_selfplay_corpus.main([
+        "--out", str(out), "--pairs", "oneply,heuristic", "--games", "8",
+        "--chunk", "4", "--max-moves", "450", "--seed", "5",
+    ])
+    ds = GoDataset(str(out / "processed"), "train")
+    assert len(ds) > 0 and ds.num_games >= 1
+    # rank tags: oneply=8d / heuristic=4d, colors alternating inside a chunk
+    pairs = {(b, w) for b, w in ds.meta[:, [3, 4]].tolist()}
+    assert pairs <= {(8, 4), (4, 8)} and pairs
+    stats = build(str(out / "processed" / "train"), str(out / "sgf" / "train"))
+    assert stats["missing"] == 0
+    assert stats["games"] == ds.num_games
+    # games that finish on double pass must carry RE[] -> decided
+    assert stats["decided"] > 0
