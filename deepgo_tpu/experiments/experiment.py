@@ -80,6 +80,12 @@ class ExperimentConfig:
     scheme: str = "game"
     loader_threads: int = 2
     prefetch: int = 4
+    # KL-anchored fine-tuning: keep the policy near a frozen reference
+    # checkpoint while training on a narrow corpus (the regularizer for
+    # the expert-iteration distribution collapse, RESULTS.md). weight 0
+    # disables; the anchor may be any architecture.
+    anchor_checkpoint: str = ""
+    anchor_weight: float = 0.0
     # parallelism (mesh axes; reference analogue: numGPUs, experiments.lua:10)
     data_parallel: int = 0  # 0 = all available devices
     tensor_parallel: int = 1
@@ -149,14 +155,26 @@ class Experiment:
         rep = replicated_sharding(self.mesh)
         self.params = jax.device_put(self.params, rep)
         self.opt_state = jax.device_put(self.opt_state, rep)
+        anchor = None
+        assert bool(cfg.anchor_checkpoint) == (cfg.anchor_weight > 0), (
+            "anchor_checkpoint and anchor_weight > 0 go together: "
+            f"got checkpoint={cfg.anchor_checkpoint!r} "
+            f"weight={cfg.anchor_weight}")
+        if cfg.anchor_weight > 0:
+            from ..models.serving import load_policy
+
+            _, a_params, a_cfg = load_policy(cfg.anchor_checkpoint)
+            anchor = (jax.device_put(a_params, rep), a_cfg,
+                      cfg.anchor_weight)
         self.train_step = make_train_step(self.model_cfg, self.optimizer,
                                           expand_backend=cfg.expand_backend,
-                                          augment=cfg.augment)
+                                          augment=cfg.augment, anchor=anchor)
         # the train loop drives this scan-based variant: K steps per device
         # dispatch (see ExperimentConfig.steps_per_call)
         self.train_step_many = make_train_step_many(
             self.model_cfg, self.optimizer,
-            expand_backend=cfg.expand_backend, augment=cfg.augment)
+            expand_backend=cfg.expand_backend, augment=cfg.augment,
+            anchor=anchor)
         self.eval_step = make_eval_step(self.model_cfg,
                                         expand_backend=cfg.expand_backend)
         self.batch_sharding = data_sharding(self.mesh)

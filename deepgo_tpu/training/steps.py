@@ -33,7 +33,7 @@ def nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
 
 
 def _one_step(params, opt_state, batch, cfg, optimizer, expand_planes,
-              augment):
+              augment, anchor=None):
     packed, target = batch["packed"], batch["target"]
     if augment:
         from ..ops.augment import augment_batch
@@ -46,7 +46,20 @@ def _one_step(params, opt_state, batch, cfg, optimizer, expand_planes,
 
     def loss_fn(p):
         logits = policy_cnn.apply(p, planes, cfg)
-        return nll_from_logits(logits, target)
+        loss = nll_from_logits(logits, target)
+        if anchor is not None:
+            # KL-anchored fine-tune: add weight * CE(anchor_probs, model).
+            # CE differs from KL(anchor || model) only by the anchor's
+            # (constant) entropy, so the gradients are the KL gradients;
+            # the anchor forward runs inside the same fused program. The
+            # reported loss includes the anchor term.
+            a_params, a_cfg, weight = anchor
+            a_logits = policy_cnn.apply(a_params, planes, a_cfg)
+            a_prob = jax.lax.stop_gradient(
+                jax.nn.softmax(a_logits.astype(jnp.float32), axis=-1))
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            loss = loss + weight * (-(a_prob * logp).sum(axis=-1).mean())
+        return loss
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
     params, opt_state = optimizer.update(params, grads, opt_state)
@@ -54,25 +67,33 @@ def _one_step(params, opt_state, batch, cfg, optimizer, expand_planes,
 
 
 def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
-                    expand_backend: str = "xla", augment: bool = False):
+                    expand_backend: str = "xla", augment: bool = False,
+                    anchor=None):
     """Returns step(params, opt_state, batch) -> (params, opt_state, loss).
 
     With ``augment=True`` the batch carries a per-sample "sym" entry and the
     packed record + target are dihedral-transformed on device before
     expansion (the augmentation the reference stubbed, dataloader.lua:41-44).
+
+    ``anchor=(anchor_params, anchor_cfg, weight)`` adds a KL-to-anchor
+    regularizer (see _one_step): the fine-tune stays near a frozen
+    reference policy — the guard against the distribution collapse the
+    expert-iteration study measured (RESULTS.md). The anchor params are
+    closed over and become constants of the fused program.
     """
     expand_planes = get_expand_fn(expand_backend)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch):
         return _one_step(params, opt_state, batch, cfg, optimizer,
-                         expand_planes, augment)
+                         expand_planes, augment, anchor)
 
     return step
 
 
 def make_train_step_many(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
-                         expand_backend: str = "xla", augment: bool = False):
+                         expand_backend: str = "xla", augment: bool = False,
+                         anchor=None):
     """Returns step(params, opt_state, batches) -> (params, opt_state, losses).
 
     ``batches`` is a superbatch: the same dict as ``make_train_step`` takes
@@ -92,7 +113,7 @@ def make_train_step_many(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
         def body(carry, batch):
             params, opt_state, loss = _one_step(
                 carry[0], carry[1], batch, cfg, optimizer, expand_planes,
-                augment)
+                augment, anchor)
             return (params, opt_state), loss
 
         (params, opt_state), losses = jax.lax.scan(

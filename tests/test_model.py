@@ -142,3 +142,54 @@ def test_eval_step_counts():
     sum_nll, correct = evaluate(params, batch)
     assert sum_nll.shape == () and 0 <= int(correct) <= 4
     assert float(sum_nll) > 0
+
+
+def test_anchored_step_pulls_toward_anchor():
+    """KL-anchored fine-tune: with a strong anchor term, training on
+    arbitrary targets keeps the model's predictions close to the frozen
+    anchor policy; without it they drift to the targets."""
+    cfg = ModelConfig(num_layers=2, channels=8)
+    anchor_params = init(jax.random.key(7), cfg)
+    packed, player, rank = _packed_batch(n=4)
+    batch = {
+        "packed": jnp.asarray(packed),
+        "player": jnp.asarray(player),
+        "rank": jnp.asarray(rank),
+        "target": jnp.asarray(np.array([3, 77, 240, 360], dtype=np.int32)),
+    }
+
+    from deepgo_tpu.ops import expand_planes
+
+    planes = expand_planes(batch["packed"], batch["player"], batch["rank"],
+                           dtype=jnp.float32)
+    a_prob = np.asarray(jax.nn.softmax(
+        apply(anchor_params, planes, cfg).astype(jnp.float32), axis=-1))
+
+    def ce_to_anchor(p):
+        logp = np.asarray(jax.nn.log_softmax(
+            apply(p, planes, cfg).astype(jnp.float32), axis=-1))
+        return float(-(a_prob * logp).sum(-1).mean())
+
+    def nll_on_targets(p):
+        logp = np.asarray(jax.nn.log_softmax(
+            apply(p, planes, cfg).astype(jnp.float32), axis=-1))
+        return float(-logp[np.arange(4), np.asarray(batch["target"])].mean())
+
+    results = {}
+    for weight in (0.0, 20.0):
+        params = init(jax.random.key(1), cfg)
+        opt = sgd(0.05)
+        opt_state = opt.init(params)
+        anchor = (anchor_params, cfg, weight) if weight else None
+        step = make_train_step(cfg, opt, anchor=anchor)
+        for _ in range(40):
+            params, opt_state, loss = step(params, opt_state, batch)
+        results[weight] = (ce_to_anchor(params), nll_on_targets(params),
+                           float(loss))
+    # the anchored run stays measurably closer to the anchor distribution
+    # and resists overfitting the 4 arbitrary targets; the plain run does
+    # the opposite
+    assert results[20.0][0] < results[0.0][0] - 0.5
+    assert results[20.0][1] > results[0.0][1]
+    # the anchored loss includes the extra (positive) CE term
+    assert results[20.0][2] > results[0.0][2]
