@@ -93,7 +93,14 @@ def _preflight_probe(mode: str = "inference") -> None:
     the full environment (including the relay sitecustomize) so it probes
     exactly the backend the benchmark will use; it exits immediately after
     the claim, releasing the single-tenant grant before the main process
-    claims. Disable with BENCH_PREFLIGHT=0.
+    claims.
+
+    Relay wedges are often transient (BENCH_r03.json was zeroed by a single
+    timed-out probe that would have succeeded minutes later), so the probe
+    retries with backoff — bounded attempts, same canary idea as
+    tools/r3_tpu_queue.sh — and only gives up after the last attempt.
+    Tune with BENCH_PREFLIGHT_TRIES / BENCH_PREFLIGHT_BACKOFF_S; disable
+    entirely with BENCH_PREFLIGHT=0.
     """
     import subprocess
     import sys
@@ -101,25 +108,35 @@ def _preflight_probe(mode: str = "inference") -> None:
     if os.environ.get("BENCH_PREFLIGHT") == "0":
         return
     timeout_s = float(os.environ.get("BENCH_PREFLIGHT_S", "60"))
+    tries = max(1, int(os.environ.get("BENCH_PREFLIGHT_TRIES", "3")))
+    backoff_s = float(os.environ.get("BENCH_PREFLIGHT_BACKOFF_S", "60"))
     # the probe must dial the same backend the benchmark will use, so it
     # re-asserts JAX_PLATFORMS exactly like honor_platform_env (the
     # terminal's sitecustomize overrides the env var at interpreter start)
     code = ("import os, jax; w = os.environ.get('JAX_PLATFORMS'); "
             "w and jax.config.update('jax_platforms', w); "
             "print(jax.devices()[0].platform, flush=True)")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        print(_diagnostic_json(
-            f"pre-flight device probe timed out after {timeout_s}s "
-            "(TPU relay claim likely wedged)", mode), flush=True)
-        raise SystemExit(1)
-    if r.returncode != 0:
-        print(_diagnostic_json(
-            "pre-flight device probe failed: " + r.stderr[-400:].strip(),
-            mode), flush=True)
-        raise SystemExit(1)
+    last_error = "pre-flight device probe never ran"
+    for attempt in range(1, tries + 1):
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            last_error = (f"pre-flight device probe timed out after "
+                          f"{timeout_s}s on attempt {attempt}/{tries} "
+                          "(TPU relay claim likely wedged)")
+        else:
+            if r.returncode == 0:
+                return
+            last_error = (f"pre-flight device probe failed on attempt "
+                          f"{attempt}/{tries}: " + r.stderr[-400:].strip())
+        if attempt < tries:
+            print(f"bench preflight: {last_error}; retrying in "
+                  f"{backoff_s:.0f}s", file=sys.stderr, flush=True)
+            time.sleep(backoff_s)
+    print(_diagnostic_json(last_error, mode), flush=True)
+    raise SystemExit(1)
 
 
 def _conv_flops_per_sample(cfg) -> float:

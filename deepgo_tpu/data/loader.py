@@ -27,9 +27,20 @@ import numpy as np
 from .dataset import GoDataset
 
 
+class LoaderClosed(RuntimeError):
+    """get()/_host_batch called on (or blocked in) a closed AsyncLoader."""
+
+
 def make_host_batch(dataset: GoDataset, rng: np.random.Generator, batch_size: int,
-                    scheme: str = "game", augment: bool = False) -> dict:
+                    scheme: str = "game", augment: bool = False,
+                    wire: str = "packed") -> dict:
     packed, player, rank, target = dataset.sample_batch(rng, batch_size, scheme)
+    if wire == "nibble":
+        # transfer encoding: two cells per byte, halving relay bytes
+        # (deepgo_tpu.ops.wire; the jitted step decodes symmetrically)
+        from ..ops.wire import nibble_pack_np
+
+        packed = nibble_pack_np(packed)
     batch = {"packed": packed, "player": player, "rank": rank, "target": target}
     if augment:
         # per-sample dihedral symmetry index, applied on device
@@ -52,16 +63,27 @@ class AsyncLoader:
         augment: bool = False,
         stack: int = 0,
         stack_sharding=None,
+        wire: str = "packed",
+        device_prefetch: int = 0,
     ):
         """``stack=K`` (K >= 1) makes ``get()`` return superbatches: K host
         batches stacked to (K, B, ...) and transferred in one device_put,
         for the scan-based multi-step train program
         (training.make_train_step_many). ``stack_sharding`` places them
         (parallel.superbatch_sharding); ``stack=0`` keeps the one-batch
-        behavior."""
+        behavior.
+
+        ``wire="nibble"`` ships packed records two-cells-per-byte (half the
+        host->device bytes; the step must be built with the same wire=).
+        ``device_prefetch=N`` (with ``num_threads > 0``) adds an uploader
+        thread that assembles and ``device_put``s up to N (super)batches
+        ahead, so the transfer of batch n+1 runs while the device computes
+        batch n even when ``device_put`` itself blocks (as it does through
+        the relay tunnel)."""
         self.dataset = dataset
         self.batch_size = batch_size
         self.scheme = scheme
+        self.wire = wire
         if scheme == "winner":
             # fail fast here, not inside a worker thread: a sampler raise
             # in a worker dies silently and get() then blocks forever on
@@ -86,6 +108,8 @@ class AsyncLoader:
         self.stack_sharding = stack_sharding
         self.num_threads = num_threads
         self._seq = np.random.SeedSequence(seed)
+        self._worker_error: BaseException | None = None
+        self._dev_queue: queue.Queue | None = None
         if num_threads > 0:
             # prefetch is in units of get() calls: scale the single-batch
             # queue by the stack depth so a whole superbatch can be buffered
@@ -103,32 +127,60 @@ class AsyncLoader:
             ]
             for t in self._threads:
                 t.start()
+            if device_prefetch > 0:
+                self._dev_queue = queue.Queue(maxsize=device_prefetch)
+                self._uploader = threading.Thread(target=self._upload_loop,
+                                                  daemon=True)
+                self._threads.append(self._uploader)
+                self._uploader.start()
         else:
             self._rng = np.random.default_rng(self._seq)
 
     def _worker(self, rng: np.random.Generator) -> None:
-        while not self._stop.is_set():
-            batch = make_host_batch(self.dataset, rng, self.batch_size,
-                                    self.scheme, self.augment)
+        try:
             while not self._stop.is_set():
-                try:
-                    self._queue.put(batch, timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
+                batch = make_host_batch(self.dataset, rng, self.batch_size,
+                                        self.scheme, self.augment, self.wire)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — surfaced via get()
+            # a raise here used to kill the thread silently; with every
+            # worker dead, get() then blocked on the empty queue forever.
+            # Stash the first error (and stop the pool) so the consumer's
+            # next get() re-raises it instead of deadlocking.
+            if self._worker_error is None:
+                self._worker_error = e
+            self._stop.set()
+
+    def _drain(self, q: queue.Queue):
+        """Shutdown-aware blocking get: re-raises a stashed worker error,
+        raises LoaderClosed once close() has been called (so neither a
+        consumer nor the uploader thread can spin forever on a queue whose
+        producers have exited), otherwise returns the next item."""
+        while True:
+            if self._worker_error is not None:
+                raise RuntimeError(
+                    "AsyncLoader worker thread died"
+                ) from self._worker_error
+            if self._stop.is_set():
+                raise LoaderClosed("AsyncLoader is closed")
+            try:
+                return q.get(timeout=0.5)
+            except queue.Empty:
+                continue
 
     def _host_batch(self) -> dict:
         if self.num_threads > 0:
-            return self._queue.get()
+            return self._drain(self._queue)
         return make_host_batch(self.dataset, self._rng, self.batch_size,
-                               self.scheme, self.augment)
+                               self.scheme, self.augment, self.wire)
 
-    def get(self, stack: int | None = None) -> dict:
-        """Next (super)batch, already dispatched to device (async transfer).
-
-        ``stack`` overrides the constructor's stack depth for this call
-        (used for a final partial window when iters % K != 0)."""
-        stack = self.stack if stack is None else stack
+    def _assemble(self, stack: int):
+        """Stack + device_put one (super)batch at the given depth."""
         if stack < 1:
             batch = self._host_batch()
             if self.sharding is not None:
@@ -139,6 +191,38 @@ class AsyncLoader:
         if self.stack_sharding is not None:
             return jax.device_put(batch, self.stack_sharding)
         return jax.device_put(batch)
+
+    def _upload_loop(self) -> None:
+        """Uploader thread: keep the device queue full of ready-to-run
+        (super)batches at the default stack depth. device_put blocking (the
+        relay tunnel) then costs this thread's time, not the train loop's."""
+        try:
+            while not self._stop.is_set():
+                batch = self._assemble(self.stack)
+                while not self._stop.is_set():
+                    try:
+                        self._dev_queue.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except LoaderClosed:
+            return  # normal shutdown
+        except BaseException as e:  # noqa: BLE001 — surfaced via get()
+            if self._worker_error is None:
+                self._worker_error = e
+            self._stop.set()
+
+    def get(self, stack: int | None = None) -> dict:
+        """Next (super)batch, already dispatched to device (async transfer).
+
+        ``stack`` overrides the constructor's stack depth for this call
+        (used for a final partial window when iters % K != 0; such
+        off-depth requests bypass the device-prefetch queue — sampling is
+        i.i.d., so ordering against prefetched batches is immaterial)."""
+        stack = self.stack if stack is None else stack
+        if self._dev_queue is not None and stack == self.stack:
+            return self._drain(self._dev_queue)
+        return self._assemble(stack)
 
     def __iter__(self):
         while True:

@@ -80,6 +80,14 @@ class ExperimentConfig:
     scheme: str = "game"
     loader_threads: int = 2
     prefetch: int = 4
+    # host->device transfer encoding for packed records: "nibble" ships two
+    # cells per byte (half the bytes; lossless for the expanded planes —
+    # see deepgo_tpu.ops.wire), "packed" ships raw records
+    wire_format: str = "nibble"
+    # (super)batches the loader's uploader thread keeps device_put ahead of
+    # the train loop (0 = transfer inline in get()); hides relay-tunnel
+    # transfer latency behind device compute
+    device_prefetch: int = 2
     # KL-anchored fine-tuning: keep the policy near a frozen reference
     # checkpoint while training on a narrow corpus (the regularizer for
     # the expert-iteration distribution collapse, RESULTS.md). weight 0
@@ -156,10 +164,14 @@ class Experiment:
         self.params = jax.device_put(self.params, rep)
         self.opt_state = jax.device_put(self.opt_state, rep)
         anchor = None
-        assert bool(cfg.anchor_checkpoint) == (cfg.anchor_weight > 0), (
-            "anchor_checkpoint and anchor_weight > 0 go together: "
-            f"got checkpoint={cfg.anchor_checkpoint!r} "
-            f"weight={cfg.anchor_weight}")
+        if bool(cfg.anchor_checkpoint) != (cfg.anchor_weight > 0):
+            # config validation must survive `python -O`, so no assert: a
+            # set anchor_checkpoint with weight 0 would otherwise be
+            # silently ignored
+            raise ValueError(
+                "anchor_checkpoint and anchor_weight > 0 go together: "
+                f"got checkpoint={cfg.anchor_checkpoint!r} "
+                f"weight={cfg.anchor_weight}")
         if cfg.anchor_weight > 0:
             from ..models.serving import load_policy
 
@@ -168,15 +180,17 @@ class Experiment:
                       cfg.anchor_weight)
         self.train_step = make_train_step(self.model_cfg, self.optimizer,
                                           expand_backend=cfg.expand_backend,
-                                          augment=cfg.augment, anchor=anchor)
+                                          augment=cfg.augment, anchor=anchor,
+                                          wire=cfg.wire_format)
         # the train loop drives this scan-based variant: K steps per device
         # dispatch (see ExperimentConfig.steps_per_call)
         self.train_step_many = make_train_step_many(
             self.model_cfg, self.optimizer,
             expand_backend=cfg.expand_backend, augment=cfg.augment,
-            anchor=anchor)
+            anchor=anchor, wire=cfg.wire_format)
         self.eval_step = make_eval_step(self.model_cfg,
-                                        expand_backend=cfg.expand_backend)
+                                        expand_backend=cfg.expand_backend,
+                                        wire=cfg.wire_format)
         self.batch_sharding = data_sharding(self.mesh)
         self.run_path = os.path.join(self.config.run_dir, self.id)
         os.makedirs(self.run_path, exist_ok=True)
@@ -290,6 +304,8 @@ class Experiment:
             stack=k_steps if use_scan else 0,
             stack_sharding=superbatch_sharding(self.mesh),
             augment=cfg.augment,
+            wire=cfg.wire_format,
+            device_prefetch=cfg.device_prefetch,
         ) as loader:
             remaining = iters
             window_steps = 0
@@ -394,6 +410,10 @@ class Experiment:
         instead of round 1's first-files prefix)."""
         cfg = self.config
         packed, player, rank, target = dataset.even_n(n)
+        if cfg.wire_format == "nibble":
+            from ..ops.wire import nibble_pack_np
+
+            packed = nibble_pack_np(packed)
         batches = []
         bs = cfg.batch_size
         for i in range(0, n, bs):

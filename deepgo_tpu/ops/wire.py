@@ -1,0 +1,54 @@
+"""Nibble wire format: halve host->device bytes for packed records.
+
+End-to-end streamed training through the relay moves ~3.2 KB per position
+(the (9, 19, 19) uint8 packed record) and round 3 measured it running ~10x
+under the fused-step ceiling — the feed, not the chip, is the bottleneck
+(RESULTS.md, round-3 verdict weak finding 3). Every packed channel's value
+is only ever *compared against small constants* by the expansion
+(deepgo_tpu.features.expand_planes_np): the largest threshold anywhere is
+kills >= 7, so clamping values to 15 provably preserves every expanded
+plane. That makes 4 bits per cell lossless for the model, and two cells
+pack into one byte.
+
+Layout: the 19-cell board rows pack pairwise along the last axis into 10
+bytes (cell 18 pairs with a zero pad): (..., 19, 19) uint8 ->
+(..., 19, 10) uint8, low nibble = even cell, high nibble = odd cell.
+Packing happens on host (NumPy, in the loader workers); unpacking is the
+first op of the jitted step (jnp), where XLA fuses the shifts into the
+expansion's comparisons. The on-disk shard format is unchanged — this is
+transfer encoding only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import BOARD_SIZE
+
+WIRE_WIDTH = (BOARD_SIZE + 1) // 2  # 10 bytes per 19-cell row
+
+
+def nibble_pack_np(packed: np.ndarray) -> np.ndarray:
+    """(..., 19, 19) uint8 -> (..., 19, 10) uint8 on host.
+
+    Values clamp to 15 first; see module docstring for why that is lossless
+    with respect to the expanded planes.
+    """
+    assert packed.shape[-1] == BOARD_SIZE and packed.dtype == np.uint8
+    clamped = np.minimum(packed, 15)
+    even = clamped[..., 0::2]  # cells 0,2,...,18 -> all 10 output bytes
+    out = even.copy()
+    out[..., : BOARD_SIZE // 2] |= clamped[..., 1::2] << 4
+    return out
+
+
+def nibble_unpack(wire: jnp.ndarray) -> jnp.ndarray:
+    """(..., 19, 10) uint8 -> (..., 19, 19) uint8 on device (jit-friendly)."""
+    lo = wire & jnp.uint8(0x0F)
+    hi = wire >> jnp.uint8(4)
+    # interleave lo/hi back to 20 cells, drop the pad cell
+    out = jnp.stack([lo, hi], axis=-1).reshape(*wire.shape[:-1],
+                                               2 * WIRE_WIDTH)
+    return out[..., :BOARD_SIZE]

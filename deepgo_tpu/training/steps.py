@@ -32,9 +32,23 @@ def nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return -picked.mean()
 
 
+def _unwire(packed, wire: str):
+    """Decode the transfer encoding of the "packed" batch entry (see
+    deepgo_tpu.ops.wire): "packed" = raw (B, 9, 19, 19) records, "nibble" =
+    (B, 9, 19, 10) two-cells-per-byte. First op of every jitted step so the
+    rest of the program always sees raw packed records."""
+    if wire == "nibble":
+        from ..ops.wire import nibble_unpack
+
+        return nibble_unpack(packed)
+    if wire != "packed":  # no assert: must fail under python -O too
+        raise ValueError(f"unknown wire format {wire!r}")
+    return packed
+
+
 def _one_step(params, opt_state, batch, cfg, optimizer, expand_planes,
-              augment, anchor=None):
-    packed, target = batch["packed"], batch["target"]
+              augment, anchor=None, wire="packed"):
+    packed, target = _unwire(batch["packed"], wire), batch["target"]
     if augment:
         from ..ops.augment import augment_batch
 
@@ -68,7 +82,7 @@ def _one_step(params, opt_state, batch, cfg, optimizer, expand_planes,
 
 def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
                     expand_backend: str = "xla", augment: bool = False,
-                    anchor=None):
+                    anchor=None, wire: str = "packed"):
     """Returns step(params, opt_state, batch) -> (params, opt_state, loss).
 
     With ``augment=True`` the batch carries a per-sample "sym" entry and the
@@ -86,14 +100,14 @@ def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch):
         return _one_step(params, opt_state, batch, cfg, optimizer,
-                         expand_planes, augment, anchor)
+                         expand_planes, augment, anchor, wire)
 
     return step
 
 
 def make_train_step_many(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
                          expand_backend: str = "xla", augment: bool = False,
-                         anchor=None):
+                         anchor=None, wire: str = "packed"):
     """Returns step(params, opt_state, batches) -> (params, opt_state, losses).
 
     ``batches`` is a superbatch: the same dict as ``make_train_step`` takes
@@ -113,7 +127,7 @@ def make_train_step_many(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
         def body(carry, batch):
             params, opt_state, loss = _one_step(
                 carry[0], carry[1], batch, cfg, optimizer, expand_planes,
-                augment, anchor)
+                augment, anchor, wire)
             return (params, opt_state), loss
 
         (params, opt_state), losses = jax.lax.scan(
@@ -123,7 +137,8 @@ def make_train_step_many(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
     return step
 
 
-def make_eval_step(cfg: policy_cnn.ModelConfig, expand_backend: str = "xla"):
+def make_eval_step(cfg: policy_cnn.ModelConfig, expand_backend: str = "xla",
+                   wire: str = "packed"):
     """Returns eval(params, batch) -> (sum_nll, num_correct) over the batch
     (the building block of validation; reference eval_validation,
     train.lua:14-45). An optional float "mask" entry (1 = real example)
@@ -133,7 +148,7 @@ def make_eval_step(cfg: policy_cnn.ModelConfig, expand_backend: str = "xla"):
     @jax.jit
     def step(params, batch):
         planes = expand_planes(
-            batch["packed"], batch["player"], batch["rank"],
+            _unwire(batch["packed"], wire), batch["player"], batch["rank"],
             dtype=jnp.dtype(cfg.compute_dtype),
         )
         mask = batch.get("mask")

@@ -38,14 +38,30 @@ def test_watchdog_emits_contract_json_and_fails():
 def test_preflight_probe_fails_fast_on_unreachable_device():
     # A bogus platform makes the probe child die quickly; bench must emit
     # one schema-compliant JSON line and exit 1 without ever arming the
-    # 900s path.
-    proc = _run_bench({"JAX_PLATFORMS": "no_such_platform"}, timeout=120)
+    # 900s path. Retries pinned to 1 here; the retry path has its own test.
+    proc = _run_bench({"JAX_PLATFORMS": "no_such_platform",
+                       "BENCH_PREFLIGHT_TRIES": "1"}, timeout=120)
     assert proc.returncode == 1
     lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
     assert len(lines) == 1
     record = json.loads(lines[0])
     assert record["value"] == 0.0
     assert "pre-flight" in record["error"]
+
+
+def test_preflight_probe_retries_before_giving_up():
+    # One transient relay wedge must not zero the round's artifact
+    # (BENCH_r03.json): the probe retries with backoff, announcing each
+    # retry on stderr, and only the LAST failed attempt emits the JSON.
+    proc = _run_bench({"JAX_PLATFORMS": "no_such_platform",
+                       "BENCH_PREFLIGHT_TRIES": "3",
+                       "BENCH_PREFLIGHT_BACKOFF_S": "0.1"}, timeout=120)
+    assert proc.returncode == 1
+    assert proc.stderr.count("retrying") == 2
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    record = json.loads(lines[0])
+    assert "attempt 3/3" in record["error"]
 
 
 @pytest.mark.skipif(not os.environ.get("DEEPGO_BENCH_FULL"),

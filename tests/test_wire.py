@@ -1,0 +1,96 @@
+"""Nibble wire format: losslessness, step equivalence, loader integration."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepgo_tpu.features import expand_planes_np
+from deepgo_tpu.ops.wire import nibble_pack_np, nibble_unpack
+
+
+def _random_packed(rng, shape_prefix=()):
+    # realistic value ranges, including values past the clamp (liberties of
+    # a huge chain can exceed 15; the expansion only sees >= thresholds)
+    return rng.integers(0, 40, size=(*shape_prefix, 9, 19, 19)).astype(np.uint8)
+
+
+def test_roundtrip_preserves_clamped_values():
+    rng = np.random.default_rng(0)
+    packed = _random_packed(rng, (4,))
+    wire = nibble_pack_np(packed)
+    assert wire.shape == (4, 9, 19, 10) and wire.dtype == np.uint8
+    out = np.asarray(nibble_unpack(wire))
+    np.testing.assert_array_equal(out, np.minimum(packed, 15))
+
+
+def test_clamp_is_lossless_for_expanded_planes():
+    # the whole argument for the format: every comparison in the expansion
+    # has threshold <= 15, so clamping cannot change any plane
+    rng = np.random.default_rng(1)
+    packed = _random_packed(rng)
+    for player, rank in ((1, 3), (2, 9)):
+        a = expand_planes_np(packed, player, rank)
+        b = expand_planes_np(np.asarray(nibble_unpack(nibble_pack_np(packed))),
+                             player, rank)
+        np.testing.assert_array_equal(a, b)
+
+
+def test_train_step_nibble_matches_packed():
+    from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.training import make_train_step
+    from deepgo_tpu.training.optimizers import OPTIMIZERS
+
+    cfg = policy_cnn.ModelConfig(num_layers=2, channels=8,
+                                 compute_dtype="float32")
+    optimizer = OPTIMIZERS["sgd"](0.05, 0.0, 0.0)
+    params = policy_cnn.init(jax.random.key(0), cfg)
+    opt_state = optimizer.init(params)
+    rng = np.random.default_rng(2)
+    packed = np.minimum(_random_packed(rng, (8,)), 15)  # pre-clamped input
+    batch = {
+        "packed": packed,
+        "player": rng.integers(1, 3, size=8).astype(np.int32),
+        "rank": rng.integers(1, 10, size=8).astype(np.int32),
+        "target": rng.integers(0, 361, size=8).astype(np.int32),
+    }
+    nib_batch = dict(batch, packed=nibble_pack_np(packed))
+
+    step_p = make_train_step(cfg, optimizer, wire="packed")
+    step_n = make_train_step(cfg, optimizer, wire="nibble")
+    p1, _, l1 = step_p(jax.tree.map(np.copy, params),
+                       jax.tree.map(np.copy, opt_state), batch)
+    p2, _, l2 = step_n(jax.tree.map(np.copy, params),
+                       jax.tree.map(np.copy, opt_state), nib_batch)
+    assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_loader_device_prefetch_and_wire(tmp_path):
+    import os
+
+    from conftest import REPO_ROOT
+    from deepgo_tpu.data import GoDataset
+    from deepgo_tpu.data.loader import AsyncLoader
+    from deepgo_tpu.data.transcribe import transcribe_split
+
+    root = tmp_path / "processed"
+    transcribe_split(os.path.join(REPO_ROOT, "data/sgf", "validation"),
+                     str(root / "validation"), workers=1, verbose=False)
+    ds = GoDataset(str(root), "validation")
+    with AsyncLoader(ds, 8, seed=3, num_threads=2, prefetch=2, stack=2,
+                     wire="nibble", device_prefetch=2) as loader:
+        batches = [loader.get() for _ in range(4)]
+        tail = loader.get(stack=0)  # off-depth request bypasses the queue
+    for b in batches:
+        assert b["packed"].shape == (2, 8, 9, 19, 10)
+    assert tail["packed"].shape == (8, 9, 19, 10)
+    # close() must terminate the uploader thread even when it was blocked
+    # draining the host queue (it held no batch when the workers exited)
+    import time
+
+    deadline = time.time() + 5
+    while any(t.is_alive() for t in loader._threads):
+        assert time.time() < deadline, "loader threads survived close()"
+        time.sleep(0.05)

@@ -1,0 +1,125 @@
+"""End-to-end streamed-training feed benchmark (round-3 verdict item 3).
+
+Round 3 measured live host-streamed 12L/128 training at ~4.5-5k samples/sec
+against a 42.5k resident-superbatch ceiling (RESULTS.md): the feed, not the
+chip, was the limit. This tool measures the full streamed path — memmap
+sampling -> host batch -> (wire encode) -> device_put -> fused K-step scan —
+under each combination of the two round-4 feed levers:
+
+  * wire_format:      "packed" (3.2 KB/position) vs "nibble" (1.7 KB)
+  * device_prefetch:  0 (transfer inline in the train loop) vs N (uploader
+                      thread overlaps transfer with device compute)
+
+plus a host-sampling-only rate (no device) to show where the host side
+saturates. One JSON line per measurement; run on the TPU via
+tools/r4_tpu_queue.sh (stage feed).
+
+Usage:
+  python tools/feed_bench.py --data-root data/corpus/processed \
+      --iters 600 --set num_layers=12 channels=128 batch_size=512
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepgo_tpu.cli import parse_overrides  # noqa: E402
+from deepgo_tpu.experiments import Experiment, ExperimentConfig  # noqa: E402
+
+
+def host_sampling_rate(data_root: str, batch_size: int, wire: str,
+                       seconds: float = 5.0) -> dict:
+    """Pure host-side sampling rate (memmap gather + wire encode), no JAX."""
+    import numpy as np
+
+    from deepgo_tpu.data import GoDataset
+    from deepgo_tpu.data.loader import make_host_batch
+
+    ds = GoDataset(data_root, "train")
+    rng = np.random.default_rng(0)
+    make_host_batch(ds, rng, batch_size, "uniform", wire=wire)  # warm cache
+    n = 0
+    t0 = time.time()
+    while time.time() - t0 < seconds:
+        make_host_batch(ds, rng, batch_size, "uniform", wire=wire)
+        n += batch_size
+    return {"kind": "host_sampling", "wire": wire,
+            "samples_per_sec": round(n / (time.time() - t0), 1)}
+
+
+def streamed_training_rate(cfg: ExperimentConfig, iters: int) -> dict:
+    """Live streamed training samples/sec for one feed configuration.
+
+    A fresh Experiment per setting (params at the same seed); the first
+    print window includes compile, so the reported rate uses the summary's
+    total samples/sec minus a warmup discount — we simply drop the first
+    window by timing from the second print onwards via metrics.jsonl.
+    """
+    exp = Experiment(cfg)
+    exp.run(iters)
+    from deepgo_tpu.utils.metrics import read_jsonl
+
+    rows = [m for m in read_jsonl(os.path.join(exp.run_path, "metrics.jsonl"))
+            if m["kind"] == "train"]
+    if not rows:
+        raise SystemExit(f"no train windows recorded: --iters must be >= "
+                         f"print_interval ({cfg.print_interval})")
+    # drop the first window (compile) whenever a steady window remains
+    steady = rows[1:] if len(rows) > 1 else rows
+    sps = sum(m["samples_per_sec"] for m in steady) / len(steady)
+    return {
+        "kind": "streamed_training",
+        "wire": cfg.wire_format,
+        "device_prefetch": cfg.device_prefetch,
+        "loader_threads": cfg.loader_threads,
+        "steps_per_call": cfg.steps_per_call,
+        "batch_size": cfg.batch_size,
+        "samples_per_sec": round(sps, 1),
+        "windows": len(steady),
+        "run_id": exp.id,
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--data-root", default="data/corpus/processed")
+    ap.add_argument("--iters", type=int, default=600)
+    ap.add_argument("--out", default="docs/feed_bench.jsonl")
+    ap.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
+    args = ap.parse_args(argv)
+
+    from deepgo_tpu.utils import honor_platform_env
+
+    honor_platform_env()
+    base = ExperimentConfig(
+        data_root=args.data_root, scheme="uniform", name="feed-bench",
+        num_layers=12, channels=128, batch_size=512, steps_per_call=20,
+        print_interval=100, validation_interval=10**9, loader_threads=4,
+        prefetch=8,
+    ).replace(**parse_overrides(args.set))
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+
+    def record(r: dict) -> None:
+        # append as produced, so a mid-sweep relay flap keeps earlier rows
+        print(json.dumps(r), flush=True)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(r) + "\n")
+
+    for wire in ("packed", "nibble"):
+        record(host_sampling_rate(args.data_root, base.batch_size, wire))
+    for wire, dev_prefetch in (("packed", 0), ("packed", 2),
+                               ("nibble", 0), ("nibble", 2)):
+        cfg = base.replace(wire_format=wire, device_prefetch=dev_prefetch)
+        record(streamed_training_rate(cfg, args.iters))
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,10 +19,14 @@ print('canary', float(jax.jit(lambda a: (a @ a).sum())(x)))" \
 
 # supervise <log> <stall_s> <cmd...>: run cmd, kill it if <log> stops
 # growing for <stall_s> seconds (a wedge mid-stage otherwise burns the
-# stage's whole timeout). rc 97 = killed for stalling.
+# stage's whole timeout). rc 97 = killed for stalling. The command runs
+# in its own session (setsid) and the whole process GROUP is killed:
+# killing only the direct child first could reparent a wedged grandchild
+# (e.g. timeout's python) to init before pkill saw it, leaking a process
+# that still held the single-tenant chip claim.
 supervise() {
   local log=$1 stall=$2; shift 2
-  "$@" &
+  setsid "$@" &
   local pid=$! last=-1 same=0
   while kill -0 $pid 2>/dev/null; do
     sleep 30
@@ -30,9 +34,8 @@ supervise() {
     if [ "$size" = "$last" ]; then
       same=$((same + 30))
       if [ $same -ge $stall ]; then
-        echo "supervise: killing stalled pid $pid (log $log frozen ${same}s)"
-        kill $pid 2>/dev/null; sleep 2; kill -9 $pid 2>/dev/null
-        pkill -9 -P $pid 2>/dev/null
+        echo "supervise: killing stalled group $pid (log $log frozen ${same}s)"
+        kill -TERM -$pid 2>/dev/null; sleep 2; kill -9 -$pid 2>/dev/null
         return 97
       fi
     else

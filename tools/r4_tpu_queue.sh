@@ -1,0 +1,137 @@
+#!/bin/bash
+# Round-4 TPU work queue: the chip-bound evidence items from the round-3
+# verdict, run sequentially so only one process holds the single-tenant
+# relay claim at a time. Stages are idempotent (done-markers / resume
+# files), so `--until-done` can re-run the whole queue across relay flaps.
+#
+# Usage: bash tools/r4_tpu_queue.sh [--until-done | stage ...]
+#   stages (default order): bench curve feed large13 flagship
+set -u
+cd "$(dirname "$0")/.."
+. tools/r3_lib.sh
+mkdir -p runs/r4logs
+CORPUS=data/corpus/processed
+FULL=3288963
+
+stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
+
+# verdict item 1: all four bench modes at round-4 HEAD (the driver's own
+# BENCH_r04.json run happens at round end; these are the RESULTS.md copies)
+run_bench() {
+  stage bench
+  for mode in inference train latency large; do
+    if [ -s runs/r4logs/bench_$mode.json ] && python - <<PY
+import json, sys
+with open("runs/r4logs/bench_$mode.json") as f:
+    d = json.loads(f.read().strip().splitlines()[-1])
+sys.exit(1 if "error" in d else 0)
+PY
+    then
+      echo "bench $mode already done"; continue
+    fi
+    canary || { echo "canary failed; skipping bench $mode"; return 1; }
+    timeout 1800 python bench.py --mode $mode \
+      > runs/r4logs/bench_$mode.json 2> runs/r4logs/bench_$mode.err
+    echo "bench $mode rc=$?"
+    tail -1 runs/r4logs/bench_$mode.json
+  done
+}
+
+# verdict item 2: the flagship 12L/128 curve's 400k and full-corpus points
+# (docs/accuracy_curve.jsonl already holds 4k + 40k; the tool skips them)
+run_curve() {
+  stage curve
+  if [ "$(wc -l < docs/accuracy_curve.jsonl 2>/dev/null || echo 0)" -ge 4 ]; then
+    echo "curve already has 4 points; skipping"; return 0
+  fi
+  canary || { echo "canary failed; skipping curve"; return 1; }
+  supervise runs/r4logs/curve.log 600 \
+    timeout 14400 python -u tools/accuracy_curve.py \
+    --data-root $CORPUS \
+    --budgets 4000,40000,400000,$FULL --iters 4000 \
+    --out docs/accuracy_curve.jsonl \
+    --set num_layers=12 channels=128 batch_size=512 \
+    >> runs/r4logs/curve.log 2>&1
+  echo "curve rc=$?"
+  tail -2 runs/r4logs/curve.log
+}
+
+# verdict item 3: the streamed-feeding gap, measured under both round-4
+# levers (nibble wire x device prefetch)
+run_feed() {
+  stage feed
+  [ -f runs/r4logs/done_feed ] && { echo "feed already done"; return 0; }
+  canary || { echo "canary failed; skipping feed"; return 1; }
+  supervise runs/r4logs/feed.log 600 \
+    timeout 7200 python -u tools/feed_bench.py \
+    --data-root $CORPUS --iters 600 \
+    >> runs/r4logs/feed.log 2>&1
+  rc=$?
+  [ $rc -eq 0 ] && touch runs/r4logs/done_feed
+  echo "feed rc=$rc"
+  grep streamed_training runs/r4logs/feed.log | tail -4
+}
+
+LARGE_ITERS=3000
+
+# flagship strength track carried over from round 3 (converge 16k iters ->
+# winner fine-tune -> arena matches -> selfplay), delegated to the r3 queue
+# whose stages are already idempotent via runs/r3logs markers
+run_flagship() {
+  stage flagship
+  bash tools/r3_tpu_queue.sh converge arena finetune selfplay
+  echo "flagship rc=$?"
+}
+
+# verdict item 7: train the 13L/256 "large" config to a real validation
+# number (BASELINE config 4), not just a step-time benchmark
+run_large13() {
+  stage large13
+  read -r CKPT STEP <<< "$(find_ckpt large13-256)"
+  if [ -n "${CKPT:-}" ] && [ "${STEP:-0}" -ge $LARGE_ITERS ]; then
+    echo "large13 already at step $STEP; skipping"; return 0
+  fi
+  canary || { echo "canary failed; skipping large13"; return 1; }
+  if [ -n "${CKPT:-}" ]; then
+    echo "resuming large13 from $CKPT (step $STEP)"
+    supervise runs/r4logs/large13.log 600 \
+      timeout 10800 python -u -m deepgo_tpu.cli train \
+      --resume "$CKPT" --iters $((LARGE_ITERS - STEP)) \
+      >> runs/r4logs/large13.log 2>&1
+  else
+    supervise runs/r4logs/large13.log 600 \
+      timeout 10800 python -u -m deepgo_tpu.cli train --iters $LARGE_ITERS --set \
+      name=large13-256 data_root=$CORPUS scheme=uniform \
+      num_layers=13 channels=256 batch_size=1024 remat=false \
+      steps_per_call=20 rate=0.02 momentum=0.9 rate_decay=1e-7 \
+      validation_interval=1000 validation_size=4096 print_interval=100 \
+      >> runs/r4logs/large13.log 2>&1
+  fi
+  echo "large13 rc=$?"
+  grep -E "validation at|samples per second" runs/r4logs/large13.log | tail -4
+}
+
+if [ "${1:-}" = "--until-done" ]; then
+  for attempt in $(seq 1 40); do
+    echo "=== until-done attempt $attempt [$(date -u +%H:%M:%S)] ==="
+    until canary; do echo "canary down; waiting"; sleep 120; done
+    out=$(bash "$0" 2>&1)
+    rc=$?
+    echo "$out"
+    # a stage aborting before its "rc=" echo (set -u, missing script)
+    # must count as failure too, hence the exit-status check
+    if [ $rc -eq 0 ] && ! echo "$out" | grep -qE "canary failed|rc=[1-9]"; then
+      echo "=== all stages complete ==="
+      exit 0
+    fi
+    sleep 60
+  done
+  echo "=== attempts exhausted ==="
+  exit 1
+fi
+
+if [ $# -eq 0 ]; then
+  set -- bench curve feed large13 flagship
+fi
+for s in "$@"; do run_$s; done
+echo "=== queue done [$(date -u +%H:%M:%S)] ==="
