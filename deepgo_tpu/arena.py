@@ -267,6 +267,126 @@ class PolicySearchAgent(PolicyAgent):
         return np.where(do_pass, -1, moves)
 
 
+def _apply_and_summarize(stones: np.ndarray, age: np.ndarray,
+                         moves: np.ndarray, players: np.ndarray
+                         ) -> tuple[np.ndarray, np.ndarray]:
+    """Apply one move per board in place; return (new packed, ko points).
+
+    Native batched path when the C++ engine is loaded (one FFI crossing for
+    the whole fleet); otherwise the tested Python GameState/apply_move
+    logic per board. ko[i] is the flat index banned for the opponent's
+    immediate recapture, -1 if none.
+    """
+    from .go import native
+
+    if native.batch_available():
+        ko = native.play_batch_native(stones, age, moves, players)
+        return native.summarize_batch_native(stones, age), ko
+    from .selfplay import GameState, apply_move, summarize_state
+
+    ko = np.full(len(moves), -1, dtype=np.int32)
+    packed = np.empty((len(moves), 9, 19, 19), dtype=np.uint8)
+    for i in range(len(moves)):
+        g = GameState()
+        g.stones[:], g.age[:], g.player = stones[i], age[i], int(players[i])
+        apply_move(g, *divmod(int(moves[i]), 19))
+        stones[i], age[i] = g.stones, g.age
+        if g.ko_point is not None:
+            ko[i] = g.ko_point[0] * 19 + g.ko_point[1]
+        packed[i] = summarize_state(g)
+    return packed, ko
+
+
+class TwoPlyAgent(PolicySearchAgent):
+    """Policy-pruned 2-ply search: candidates from the net, replies refuted.
+
+    The expert-iteration study (RESULTS.md) showed the strength loop
+    saturating because the 1-ply veto expert caps what distillation can
+    teach; this agent is the next expert up. Per board it
+
+      1. takes the policy's ``top_k`` moves plus every live forcing move as
+         the candidate set (the policy as search prior, arXiv:1412.6564
+         §Conclusion — the same pruning role the paper projects),
+      2. PLAYS each candidate on a copy of the board (batched native move
+         application across the whole fleet x candidate set), and
+      3. scores it as the 1-ply tactical gain now MINUS the opponent's best
+         forcing response on the resulting board (capture/save/ladder
+         component of ``_oneply_scores``, ko-banned reply excluded) —
+         so snapbacks, self-ataris beyond the immediate stone, and
+         captures that hand back a bigger recapture are all seen, which
+         the purely-static OnePlyAgent cannot do (reference analogue:
+         count_kills_and_liberties, makedata.lua:304-327, is exactly one
+         hypothetical ply deep).
+
+    The policy keeps the move unless its own candidate is REFUTED: the best
+    candidate must beat the policy move's 2-ply score by ``margin``
+    (default 500, half a capture tier) for the search to take over. This
+    differential veto generalizes round 3's forcing-move veto — blanket
+    re-ranking measurably drags a strong policy down to its evaluator's
+    level (RESULTS.md), so the agent only overrides on a demonstrated
+    tactical blunder.
+    """
+
+    name = "twoply-search"
+
+    def __init__(self, params, cfg, name: str = "twoply-search",
+                 margin: int = 500, **kw):
+        super().__init__(params, cfg, name=name, **kw)
+        self.margin = margin
+
+    def select_moves(self, packed, players, legal, rng):
+        from .features import P_AGE, P_STONES
+
+        legal = _no_own_eyes(packed, players, legal)
+        logp = self._legal_log_probs(packed, players, legal)
+        tact1, forcing1 = _oneply_scores(packed, players)
+        n = len(packed)
+        any_legal = legal.any(axis=1)
+        policy_move = np.where(any_legal, logp.argmax(axis=1), -1)
+
+        # candidate set: policy top-k (includes its argmax) + forcing moves
+        k = min(self.top_k, logp.shape[1])
+        kth = np.partition(logp, -k, axis=1)[:, -k][:, None]
+        cand = legal & ((logp >= kth) | (forcing1 >= self.urgent))
+        rows, cols = np.nonzero(cand)
+        if rows.size == 0:
+            return policy_move
+
+        # play every candidate on a board copy, measure the opponent's best
+        # forcing reply on each resulting position
+        stones = packed[rows, P_STONES].astype(np.uint8).copy()
+        age = packed[rows, P_AGE].astype(np.int32)
+        after, ko = _apply_and_summarize(stones, age, cols.astype(np.int32),
+                                         players[rows].astype(np.int32))
+        opp = (3 - players[rows]).astype(np.int32)
+        _, forcing_reply = _oneply_scores(after, opp)
+        reply_legal = legal_mask(after, opp)
+        flat = np.arange(len(rows))
+        banned = ko >= 0
+        reply_legal[flat[banned], ko[banned]] = False
+        threat = np.where(reply_legal, forcing_reply, 0).max(axis=1)
+
+        # 2-ply score: my tactical gain minus the best response I allow;
+        # policy prob in (0,1] + sub-ulp noise breaks integer-tier ties
+        score2 = np.full((n, logp.shape[1]), -np.inf)
+        score2[rows, cols] = tact1[rows, cols].astype(np.float64) - threat
+        score2 += np.where(cand, np.exp(logp) + rng.random(logp.shape) * 1e-9,
+                           0.0)
+        best2 = score2.argmax(axis=1)
+        best2_val = score2.max(axis=1)
+        pol_val = np.where(any_legal,
+                           score2[np.arange(n), policy_move], -np.inf)
+
+        # differential veto: override only when the policy's move is
+        # refuted at 2 ply by a full tactical margin
+        fire = any_legal & (best2_val >= pol_val + self.margin)
+        moves = np.where(fire, best2, policy_move)
+
+        best_p = np.exp(logp.max(axis=1, initial=-np.inf))
+        do_pass = (best_p < self.pass_threshold) & ~fire
+        return np.where(do_pass, -1, moves)
+
+
 def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
                komi: float = 7.5, max_moves: int = 450, seed: int = 0):
     """Run n_games with alternating colors; returns (games, scores, stats).
@@ -364,6 +484,11 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         # deterministic even in a mixed policy-vs-search match
         _, params, cfg = load_policy(spec.split(":", 1)[1])
         return PolicySearchAgent(params, cfg, rank=rank)
+    if spec.startswith("search2:"):
+        from .models.serving import load_policy
+
+        _, params, cfg = load_policy(spec.split(":", 1)[1])
+        return TwoPlyAgent(params, cfg, rank=rank)
     if spec.startswith("model:"):  # random-init policy, for smoke runs
         cfg = policy_cnn.CONFIGS[spec.split(":", 1)[1]]
         params = policy_cnn.init(jax.random.key(seed), cfg)
@@ -372,7 +497,7 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
     raise ValueError(
         f"unknown agent spec {spec!r} "
         "(use random | heuristic | oneply | checkpoint:PATH | search:PATH "
-        "| model:NAME)")
+        "| search2:PATH | model:NAME)")
 
 
 def main(argv=None) -> None:

@@ -370,3 +370,107 @@ class TestArena:
         for g in games:
             for move in g.moves:
                 assert 0 <= move.x < 19 and 0 <= move.y < 19
+
+
+class TestTwoPlyAgent:
+    @staticmethod
+    def _agent(**kw):
+        import jax
+
+        from deepgo_tpu.models import policy_cnn
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        return arena.TwoPlyAgent(params, cfg, **kw)
+
+    @staticmethod
+    def _position(game):
+        from deepgo_tpu.selfplay import legal_mask, summarize_state
+
+        packed = summarize_state(game)[None]
+        players = np.array([game.player], dtype=np.int32)
+        legal = legal_mask(packed, players, [game])
+        return packed, players, legal
+
+    def test_apply_and_summarize_fallback_matches_native(self, monkeypatch):
+        # the Python fallback path must produce the same packed boards and
+        # ko points the native batched path does
+        from deepgo_tpu.go import native
+
+        if not native.batch_available():
+            pytest.skip("native batch engine not built")
+        g = arena.GameState()
+        play(g.stones, g.age, 0, 0, WHITE)
+        play(g.stones, g.age, 1, 0, BLACK)
+        stones = np.stack([g.stones, g.stones])
+        age = np.stack([g.age, g.age])
+        moves = np.array([0 * 19 + 1, 5 * 19 + 5], dtype=np.int32)
+        players = np.array([1, 1], dtype=np.int32)
+        pk_n, ko_n = arena._apply_and_summarize(
+            stones.copy(), age.copy(), moves, players)
+        monkeypatch.setattr(native, "batch_available", lambda: False)
+        pk_p, ko_p = arena._apply_and_summarize(
+            stones.copy(), age.copy(), moves, players)
+        np.testing.assert_array_equal(pk_n, pk_p)
+        np.testing.assert_array_equal(ko_n, ko_p)
+
+    def test_quiet_board_plays_policy_argmax(self):
+        # no tactics anywhere: the differential veto must not fire and the
+        # move must be exactly the policy's argmax
+        agent = self._agent()
+        g = arena.GameState()
+        play(g.stones, g.age, 10, 10, BLACK)
+        play(g.stones, g.age, 3, 16, WHITE)
+        g.player = 1
+        packed, players, legal = self._position(g)
+        masked = arena._no_own_eyes(packed, players, legal)
+        logp = agent._legal_log_probs(packed, players, masked)
+        move = agent.select_moves(packed, players, legal,
+                                  np.random.default_rng(0))[0]
+        assert move == int(logp[0].argmax())
+
+    def test_fires_on_clean_capture_policy_missed(self):
+        # a random-init policy knows nothing; the 1-stone capture is the
+        # only tactic on the board, is unrefuted (capturing stone keeps
+        # liberties), and beats any quiet move's 2-ply score by >= margin
+        agent = self._agent(top_k=1)
+        g = arena.GameState()
+        # white stone at (5,5) with black on three sides; capture at (5,6)
+        play(g.stones, g.age, 5, 5, WHITE)
+        for x, y in ((4, 5), (6, 5), (5, 4)):
+            play(g.stones, g.age, x, y, BLACK)
+        g.player = 1
+        packed, players, legal = self._position(g)
+        move = agent.select_moves(packed, players, legal,
+                                  np.random.default_rng(0))[0]
+        assert move == 5 * 19 + 6
+
+    def test_prefers_working_escape_over_refuted_one(self):
+        # black chain in atari; two candidate saves exist: extending into
+        # the open center (works: no immediate recapture, no ladder) vs a
+        # same-tier option whose result is still capturable. The 2-ply
+        # threat term must pick the working one. Construct: black stone at
+        # (0,3) edge, white at (0,2) and (1,3) -> last liberty (0,4).
+        # Extending to (0,4) leaves a 2-liberty chain on the edge that
+        # white ladders/captures; capturing the atari-giver is impossible,
+        # but black ALSO has a working counter-atari: white stone (1,3)
+        # has liberties (1,4),(2,3) -> no. Instead give black a clean
+        # capture of the (0,2) attacker: black at (1,2) and (0,1) makes
+        # (0,2) a 1-liberty white stone capturable at... (0,2)'s liberties:
+        # none left -> use (1,1) black and capture point (0,1).
+        g = arena.GameState()
+        play(g.stones, g.age, 0, 3, BLACK)   # the chain in atari
+        play(g.stones, g.age, 0, 2, WHITE)   # attacker A
+        play(g.stones, g.age, 1, 3, WHITE)   # attacker B
+        play(g.stones, g.age, 1, 2, BLACK)   # takes A's south liberty
+        play(g.stones, g.age, 1, 1, BLACK)   # helps surround A
+        # A=(0,2) liberties now: (0,1) only -> black can capture A at (0,1),
+        # which also rescues the chain (frees (0,2)).
+        g.player = 1
+        packed, players, legal = self._position(g)
+        move = self._agent(top_k=1).select_moves(
+            packed, players, legal, np.random.default_rng(0))[0]
+        # capturing A at (0,1) is the working save: gains a liberty for the
+        # chain and removes the attacker with no comeback; extending to
+        # (0,4) leaves the chain still capturable (threat stays high)
+        assert move == 0 * 19 + 1
