@@ -249,11 +249,7 @@ class PolicySearchAgent(PolicyAgent):
             # re-rank only the rows with a live forcing move — most Go
             # positions are quiet, so the partition/exp work is skipped
             # for the typical all-quiet ply
-            k = min(self.top_k, logp.shape[1])
-            # k-th largest log-prob per row; rows with < k legal moves get
-            # -inf, which admits every legal move — the right degradation
-            kth = np.partition(logp, -k, axis=1)[:, -k][:, None]
-            cand = (legal & (logp >= kth)) | urgent
+            cand = _topk_mask(logp, legal, self.top_k) | urgent
             # prob in (0, 1] breaks tactical ties without reordering
             # integer tiers; sub-ulp rng noise breaks exact ties uniformly
             prob = np.exp(logp) + rng.random(logp.shape) * 1e-9
@@ -265,6 +261,16 @@ class PolicySearchAgent(PolicyAgent):
         best_p = np.exp(logp.max(axis=1, initial=-np.inf))
         do_pass = (best_p < self.pass_threshold) & ~has_urgent
         return np.where(do_pass, -1, moves)
+
+
+def _topk_mask(logp: np.ndarray, legal: np.ndarray, top_k: int) -> np.ndarray:
+    """(n, 361) bool: the top-k log-prob legal points per row. Rows with
+    fewer than k legal moves get a kth value of -inf, which admits every
+    legal move — the right degradation. Shared by the 1-ply re-ranker and
+    the 2-ply candidate set so the rule cannot drift between them."""
+    k = min(top_k, logp.shape[1])
+    kth = np.partition(logp, -k, axis=1)[:, -k][:, None]
+    return legal & (logp >= kth)
 
 
 def _apply_and_summarize(stones: np.ndarray, age: np.ndarray,
@@ -345,9 +351,8 @@ class TwoPlyAgent(PolicySearchAgent):
         policy_move = np.where(any_legal, logp.argmax(axis=1), -1)
 
         # candidate set: policy top-k (includes its argmax) + forcing moves
-        k = min(self.top_k, logp.shape[1])
-        kth = np.partition(logp, -k, axis=1)[:, -k][:, None]
-        cand = legal & ((logp >= kth) | (forcing1 >= self.urgent))
+        cand = _topk_mask(logp, legal, self.top_k) | (
+            legal & (forcing1 >= self.urgent))
         rows, cols = np.nonzero(cand)
         if rows.size == 0:
             return policy_move
@@ -382,8 +387,14 @@ class TwoPlyAgent(PolicySearchAgent):
         fire = any_legal & (best2_val >= pol_val + self.margin)
         moves = np.where(fire, best2, policy_move)
 
+        # pass exactly when PolicySearchAgent would: policy below the pass
+        # threshold AND nothing forcing on the board. Without the urgency
+        # veto, a settled endgame whose argmax IS a live capture (fire
+        # stays False — the differential is zero) would pass over dead
+        # stones and hand them to the opponent under area scoring.
+        has_urgent = (legal & (forcing1 >= self.urgent)).any(axis=1)
         best_p = np.exp(logp.max(axis=1, initial=-np.inf))
-        do_pass = (best_p < self.pass_threshold) & ~fire
+        do_pass = (best_p < self.pass_threshold) & ~fire & ~has_urgent
         return np.where(do_pass, -1, moves)
 
 

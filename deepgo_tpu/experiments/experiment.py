@@ -82,8 +82,11 @@ class ExperimentConfig:
     prefetch: int = 4
     # host->device transfer encoding for packed records: "nibble" ships two
     # cells per byte (half the bytes; lossless for the expanded planes —
-    # see deepgo_tpu.ops.wire), "packed" ships raw records
-    wire_format: str = "nibble"
+    # see deepgo_tpu.ops.wire), "packed" ships raw records. "auto" =
+    # nibble on accelerators (the feed is transfer-bound through the
+    # relay), packed on CPU (no transfer to save; the pack/unpack would
+    # be pure overhead)
+    wire_format: str = "auto"
     # (super)batches the loader's uploader thread keeps device_put ahead of
     # the train loop (0 = transfer inline in get()); hides relay-tunnel
     # transfer latency behind device compute
@@ -151,6 +154,10 @@ class Experiment:
             f"batch_size {cfg.batch_size} must divide over {dp} data-parallel devices"
         )
         self.mesh = make_mesh(dp, cfg.tensor_parallel)
+        self.wire = cfg.wire_format
+        if self.wire == "auto":
+            self.wire = ("nibble" if jax.default_backend() != "cpu"
+                         else "packed")
         self.model_cfg = cfg.model_config()
         opt_fn = OPTIMIZERS[cfg.optimizer]
         if cfg.optimizer == "sgd":
@@ -181,16 +188,16 @@ class Experiment:
         self.train_step = make_train_step(self.model_cfg, self.optimizer,
                                           expand_backend=cfg.expand_backend,
                                           augment=cfg.augment, anchor=anchor,
-                                          wire=cfg.wire_format)
+                                          wire=self.wire)
         # the train loop drives this scan-based variant: K steps per device
         # dispatch (see ExperimentConfig.steps_per_call)
         self.train_step_many = make_train_step_many(
             self.model_cfg, self.optimizer,
             expand_backend=cfg.expand_backend, augment=cfg.augment,
-            anchor=anchor, wire=cfg.wire_format)
+            anchor=anchor, wire=self.wire)
         self.eval_step = make_eval_step(self.model_cfg,
                                         expand_backend=cfg.expand_backend,
-                                        wire=cfg.wire_format)
+                                        wire=self.wire)
         self.batch_sharding = data_sharding(self.mesh)
         self.run_path = os.path.join(self.config.run_dir, self.id)
         os.makedirs(self.run_path, exist_ok=True)
@@ -304,7 +311,7 @@ class Experiment:
             stack=k_steps if use_scan else 0,
             stack_sharding=superbatch_sharding(self.mesh),
             augment=cfg.augment,
-            wire=cfg.wire_format,
+            wire=self.wire,
             device_prefetch=cfg.device_prefetch,
         ) as loader:
             remaining = iters
@@ -410,7 +417,7 @@ class Experiment:
         instead of round 1's first-files prefix)."""
         cfg = self.config
         packed, player, rank, target = dataset.even_n(n)
-        if cfg.wire_format == "nibble":
+        if self.wire == "nibble":
             from ..ops.wire import nibble_pack_np
 
             packed = nibble_pack_np(packed)

@@ -474,3 +474,23 @@ class TestTwoPlyAgent:
         # chain and removes the attacker with no comeback; extending to
         # (0,4) leaves the chain still capturable (threat stays high)
         assert move == 0 * 19 + 1
+
+    def test_urgent_capture_vetoes_pass(self):
+        # pass_threshold=2.0 is unsatisfiable, so the policy rule alone
+        # would always pass; with a live capture on the board the agent
+        # must play on (same contract as PolicySearchAgent — passing over
+        # dead stones hands them to the opponent under area scoring)
+        agent = self._agent(top_k=1, pass_threshold=2.0)
+        g = arena.GameState()
+        play(g.stones, g.age, 0, 0, WHITE)
+        play(g.stones, g.age, 1, 0, BLACK)
+        g.player = 1
+        packed, players, legal = self._position(g)
+        rng = np.random.default_rng(0)
+        assert agent.select_moves(packed, players, legal, rng)[0] == 1
+        # and on a quiet board the same threshold does pass
+        g2 = arena.GameState()
+        play(g2.stones, g2.age, 10, 10, BLACK)
+        g2.player = 1
+        packed, players, legal = self._position(g2)
+        assert agent.select_moves(packed, players, legal, rng)[0] == -1

@@ -221,8 +221,8 @@ def test_bad_batch_postmortem_capture(data_root, tmp_path):
     with pytest.raises(FloatingPointError):
         exp.run(10)
     dump = np.load(os.path.join(exp.run_path, "bad_batch.npz"))
-    # packed is stored as transferred — default nibble wire, 10 bytes/row
-    assert dump["packed"].shape == (10, cfg.batch_size, 9, 19, 10)
+    # packed is stored as transferred — auto wire resolves to raw on CPU
+    assert dump["packed"].shape == (10, cfg.batch_size, 9, 19, 19)
     assert set(dump.files) >= {"packed", "player", "rank", "target"}
 
     exp2 = Experiment(tiny_config(data_root, run_dir=str(tmp_path / "runs2"),
@@ -232,7 +232,7 @@ def test_bad_batch_postmortem_capture(data_root, tmp_path):
     with pytest.raises(FloatingPointError):
         exp2.run(5)  # < steps_per_call -> single-step tail path
     dump = np.load(os.path.join(exp2.run_path, "bad_batch.npz"))
-    assert dump["packed"].shape == (cfg.batch_size, 9, 19, 10)
+    assert dump["packed"].shape == (cfg.batch_size, 9, 19, 19)
 
 
 def test_evaluate_full_split(data_root, tmp_path):

@@ -67,6 +67,21 @@ def test_train_step_nibble_matches_packed():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
 
+def test_experiment_wire_auto_resolves_by_backend(tmp_path):
+    # "auto" = packed on the CPU backend (no transfer to save), nibble on
+    # accelerators; an explicit setting is honored anywhere
+    from deepgo_tpu.experiments import Experiment, ExperimentConfig
+
+    cfg = ExperimentConfig(num_layers=2, channels=8, batch_size=8,
+                           data_parallel=1, run_dir=str(tmp_path))
+    exp = Experiment(cfg)
+    exp.init()
+    assert exp.wire == "packed"  # tests run on the CPU backend
+    exp2 = Experiment(cfg.replace(wire_format="nibble"))
+    exp2.init()
+    assert exp2.wire == "nibble"
+
+
 def test_loader_device_prefetch_and_wire(tmp_path):
     import os
 

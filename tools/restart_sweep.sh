@@ -1,0 +1,28 @@
+#!/bin/bash
+# Multi-seed warm-restart sweep demo (round-3 verdict item 8; BASELINE
+# config 3): K warm restarts of one trained checkpoint under fresh run ids
+# + fresh optimizers + offset sampling seeds (reference
+# experiments/repeated.lua:6-22 run with -num 1..K), then one fan-out plot
+# of every restart's validation curve next to the source run's.
+#
+# Usage: bash tools/restart_sweep.sh [checkpoint] [iters] [K]
+set -eu
+cd "$(dirname "$0")/.."
+CKPT=${1:-runs/cd164563/checkpoint.npz}
+ITERS=${2:-400}
+K=${3:-4}
+
+RUNS=$(dirname "$(dirname "$CKPT")")
+before=$(ls "$RUNS")
+for k in $(seq 1 "$K"); do
+  python -u -m deepgo_tpu.experiments.repeated \
+    --checkpoint "$CKPT" --iters "$ITERS" --num "$k" \
+    --set name=restart-sweep validation_interval=100 print_interval=100
+done
+# the new run dirs are exactly the ones repeated.py just created
+new=$(comm -13 <(echo "$before" | sort) <(ls "$RUNS" | sort) | sed "s#^#$RUNS/#")
+echo "sweep runs: $new"
+# shellcheck disable=SC2086
+python -u -m deepgo_tpu.experiments.plot $(dirname "$CKPT") $new \
+  --out docs/restart_sweep
+echo "wrote docs/restart_sweep.csv/.png"
