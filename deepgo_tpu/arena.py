@@ -350,9 +350,11 @@ class TwoPlyAgent(PolicySearchAgent):
         any_legal = legal.any(axis=1)
         policy_move = np.where(any_legal, logp.argmax(axis=1), -1)
 
-        # candidate set: policy top-k (includes its argmax) + forcing moves
-        cand = _topk_mask(logp, legal, self.top_k) | (
-            legal & (forcing1 >= self.urgent))
+        # candidate set: policy top-k (includes its argmax) + forcing moves;
+        # bound once so the candidate set and the pass-veto below cannot
+        # drift apart if the urgency rule changes
+        urgent = legal & (forcing1 >= self.urgent)
+        cand = _topk_mask(logp, legal, self.top_k) | urgent
         rows, cols = np.nonzero(cand)
         if rows.size == 0:
             return policy_move
@@ -392,7 +394,7 @@ class TwoPlyAgent(PolicySearchAgent):
         # veto, a settled endgame whose argmax IS a live capture (fire
         # stays False — the differential is zero) would pass over dead
         # stones and hand them to the opponent under area scoring.
-        has_urgent = (legal & (forcing1 >= self.urgent)).any(axis=1)
+        has_urgent = urgent.any(axis=1)
         best_p = np.exp(logp.max(axis=1, initial=-np.inf))
         do_pass = (best_p < self.pass_threshold) & ~fire & ~has_urgent
         return np.where(do_pass, -1, moves)

@@ -158,6 +158,9 @@ class Experiment:
         if self.wire == "auto":
             self.wire = ("nibble" if jax.default_backend() != "cpu"
                          else "packed")
+        if self.wire not in ("nibble", "packed"):
+            raise ValueError(f"wire_format must be auto|nibble|packed, "
+                             f"got {cfg.wire_format!r}")
         self.model_cfg = cfg.model_config()
         opt_fn = OPTIMIZERS[cfg.optimizer]
         if cfg.optimizer == "sgd":

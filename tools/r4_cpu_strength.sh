@@ -1,0 +1,140 @@
+#!/bin/bash
+# Round-4 strength-axis pipeline at CPU scale: the TwoPlyAgent evidence
+# items from the round-3 verdict (item 4), plus the augmentation
+# measurement (item 5) and the warm-restart sweep demo (item 8).
+#
+#   prereq:  tools/r3_cpu_strength.sh rebuilds cpu-base / cpu-ft2k
+#   h2h:     search2:ft2k vs search:ft2k — the new expert vs the round-3
+#            champion OPERATOR at a fixed prior, 200 games
+#   rungs:   search2:ft2k vs oneply / heuristic — absolute ladder position
+#   iter2p:  one distillation round FROM the 2-ply expert (the study's
+#            conclusion was that a fixed 1-ply expert saturates the loop;
+#            this tests whether a deeper expert un-saturates it):
+#            2,560 search2 games -> winner fine-tune 500 steps from ft2k
+#            -> raw / +veto / +2ply matches vs oneply
+#   iter3p:  second loop round from iter2p (fresh 2-ply games by the new
+#            policy, distilled back into it) — does the climb continue?
+#   augment: 3L/64 curve protocol +- augment=true at the 40k budget
+#   sweep:   tools/restart_sweep.sh from the cpu-base checkpoint
+#
+# Everything runs under JAX_PLATFORMS=cpu and nice -n 10 (never dials the
+# relay; yields the single host core to live chip work). Stages are
+# idempotent via find_ckpt / done-markers, same as the other queues.
+set -u
+cd "$(dirname "$0")/.."
+. tools/r3_lib.sh
+mkdir -p runs/r4logs
+export JAX_PLATFORMS=cpu
+CORPUS=data/corpus/processed
+N=${NICE:-10}
+
+stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
+
+# cpu_match <spec_a> <spec_b> <tag> [games]
+cpu_match() {
+  local a=$1 b=$2 tag=$3 games=${4:-200}
+  local mark=runs/r4logs/done_arena_$tag
+  [ -f "$mark" ] && { echo "arena $tag already done"; return 0; }
+  stage "arena $tag"
+  nice -n $N timeout 14400 python -u -m deepgo_tpu.arena \
+    --a "$a" --b "$b" --games "$games" --rank 8 --seed 11 \
+    >> runs/r4logs/cpu_arena.log 2>&1
+  local rc=$?
+  [ $rc -eq 0 ] && touch "$mark"
+  echo "arena $tag rc=$rc"
+  tail -1 runs/r4logs/cpu_arena.log
+}
+
+# distill <name> <from_ckpt> <corpus> [iters] -> echoes nothing; find_ckpt after
+distill() {
+  local name=$1 from=$2 corpus=$3 iters=${4:-500}
+  read -r CK STEP <<< "$(find_ckpt "$name")"
+  local from_step
+  from_step=$(CKPT="$from" python - <<'PY'
+import os
+from deepgo_tpu.experiments.checkpoint import load_meta
+print(load_meta(os.environ["CKPT"])["step"])
+PY
+)
+  if [ -n "${CK:-}" ] && [ "${STEP:-0}" -ge $((from_step + iters)) ]; then
+    echo "$name already at step $STEP"; return 0
+  fi
+  stage "distill $name"
+  for s in train validation; do
+    [ -f "$corpus/processed/$s/winner.npy" ] || nice -n $N timeout 3600 \
+      python tools/winner_index.py --processed "$corpus/processed/$s" \
+      --sgf "$corpus/sgf/$s" >> runs/r4logs/distill.log 2>&1
+  done
+  nice -n $N timeout 14400 python -u -m deepgo_tpu.experiments.repeated \
+    --checkpoint "$from" --iters "$iters" --set \
+    name="$name" data_root="$corpus/processed" scheme=winner rate=0.005 \
+    momentum=0.9 steps_per_call=1 print_interval=50 \
+    validation_interval="$iters" validation_size=2048 \
+    >> runs/r4logs/distill.log 2>&1
+  echo "distill $name rc=$?"
+}
+
+# selfplay_corpus <out> <pair...> — 2,560 games through the shard pipeline
+selfplay_corpus() {
+  local out=$1; shift
+  [ -f "$out/processed/train/planes.bin" ] && { echo "$out already built"; return 0; }
+  stage "selfplay corpus $out"
+  nice -n $N timeout 14400 python -u tools/make_selfplay_corpus.py \
+    --out "$out" --pairs "$@" --games 2560 --chunk 512 --rank 8 --seed 23 \
+    >> runs/r4logs/selfplay.log 2>&1
+  echo "selfplay corpus rc=$?"
+}
+
+# --- prereq: round-3 CPU checkpoints ---
+bash tools/r3_cpu_strength.sh || { echo "prereq pipeline failed"; exit 1; }
+read -r BASE BASE_STEP <<< "$(find_ckpt cpu-base)"
+read -r FT FT_STEP <<< "$(find_ckpt cpu-ft2k)"
+[ -n "${FT:-}" ] || { echo "no cpu-ft2k checkpoint"; exit 1; }
+echo "cpu-base: $BASE (step $BASE_STEP); cpu-ft2k: $FT (step $FT_STEP)"
+
+# --- verdict item 4a: head-to-head at fixed prior + ladder rungs ---
+cpu_match "search2:$FT" "search:$FT" twoply_vs_search_ft2k
+cpu_match "search2:$FT" oneply twoply_ft2k_oneply
+cpu_match "search2:$FT" heuristic twoply_ft2k_heuristic
+
+# --- verdict item 4b: distillation round from the 2-ply expert ---
+selfplay_corpus data/iter2p "search2:$FT,oneply" "search2:$FT,search2:$FT"
+distill cpu-ft-iter2p "$FT" data/iter2p 500
+read -r I2P I2P_STEP <<< "$(find_ckpt cpu-ft-iter2p)"
+[ -n "${I2P:-}" ] || { echo "no iter2p checkpoint"; exit 1; }
+echo "cpu-ft-iter2p: $I2P (step $I2P_STEP)"
+cpu_match "checkpoint:$I2P" oneply iter2p_raw_oneply
+cpu_match "search:$I2P" oneply iter2p_veto_oneply
+cpu_match "search2:$I2P" oneply iter2p_twoply_oneply
+
+# --- second loop round: fresh 2-ply games by iter2p, distilled back ---
+selfplay_corpus data/iter3p "search2:$I2P,oneply" "search2:$I2P,search2:$I2P"
+distill cpu-ft-iter3p "$I2P" data/iter3p 500
+read -r I3P I3P_STEP <<< "$(find_ckpt cpu-ft-iter3p)"
+if [ -n "${I3P:-}" ]; then
+  cpu_match "checkpoint:$I3P" oneply iter3p_raw_oneply
+  cpu_match "search2:$I3P" oneply iter3p_twoply_oneply
+fi
+
+# --- verdict item 5: augmentation's measured payoff (40k budget arm) ---
+if [ ! -f runs/r4logs/done_augment ]; then
+  stage augment
+  nice -n $N timeout 28800 python -u tools/accuracy_curve.py \
+    --data-root $CORPUS --budgets 40000 --iters 1500 \
+    --out docs/accuracy_curve_augment.jsonl \
+    --set num_layers=3 channels=64 batch_size=256 augment=true \
+    >> runs/r4logs/augment.log 2>&1 \
+  && touch runs/r4logs/done_augment
+  echo "augment rc=$?"
+  tail -1 docs/accuracy_curve_augment.jsonl 2>/dev/null
+fi
+
+# --- verdict item 8: multi-seed warm-restart sweep demo ---
+if [ ! -f docs/restart_sweep.png ]; then
+  stage restart_sweep
+  nice -n $N timeout 14400 bash tools/restart_sweep.sh "$BASE" 400 4 \
+    >> runs/r4logs/restart_sweep.log 2>&1
+  echo "restart sweep rc=$?"
+fi
+
+echo "=== r4 cpu strength pipeline done [$(date -u +%H:%M:%S)] ==="

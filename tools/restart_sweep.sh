@@ -13,15 +13,20 @@ ITERS=${2:-400}
 K=${3:-4}
 
 RUNS=$(dirname "$(dirname "$CKPT")")
-before=$(ls "$RUNS")
+# capture each restart's run id from repeated.py's own announcement line —
+# diffing `ls runs/` before/after would race with any concurrent pipeline
+# stage writing run dirs into the same tree
+new=""
 for k in $(seq 1 "$K"); do
-  python -u -m deepgo_tpu.experiments.repeated \
+  out=$(python -u -m deepgo_tpu.experiments.repeated \
     --checkpoint "$CKPT" --iters "$ITERS" --num "$k" \
-    --set name=restart-sweep validation_interval=100 print_interval=100
+    --set name=restart-sweep validation_interval=100 print_interval=100)
+  echo "$out" | tail -3
+  rid=$(echo "$out" | sed -n 's/^warm restart \([0-9a-f]*\) from.*/\1/p')
+  [ -n "$rid" ] || { echo "restart $k: no run id announced"; exit 1; }
+  new="$new $RUNS/$rid"
 done
-# the new run dirs are exactly the ones repeated.py just created
-new=$(comm -13 <(echo "$before" | sort) <(ls "$RUNS" | sort) | sed "s#^#$RUNS/#")
-echo "sweep runs: $new"
+echo "sweep runs:$new"
 # shellcheck disable=SC2086
 python -u -m deepgo_tpu.experiments.plot $(dirname "$CKPT") $new \
   --out docs/restart_sweep
