@@ -140,6 +140,35 @@ class OnePlyAgent(Agent):
                                        legal, rng)
 
 
+# Tactical tier weights, shared by every scoring agent (OnePly, veto,
+# 2-ply). One table so the agents' arithmetic cannot desynchronize — the
+# 2-ply differential in particular relies on W_KILL being identical in
+# its gain and threat terms.
+W_KILL = 1000      # per stone captured by playing here
+W_SAVE = 700       # per own stone the opponent could capture here (1-ply
+#                    speculative save credit; TwoPlyAgent deliberately
+#                    scores saves through the threat delta instead)
+W_LADDER = 400     # per stone capturable via a working ladder from here
+W_LIB = 12         # own liberties after playing here
+W_OPP_LIB = 6      # opponent liberties denied
+W_SELF_ATARI = 900 # penalty for leaving own chain at <= 1 liberty
+
+
+def _tactical_grids(packed: np.ndarray, players: np.ndarray):
+    """The five (n, 361) int64 planes every tactical score derives from:
+    (my_kills, opp_kills, my_libs, opp_libs, my_ladders), each read from
+    the summarizer's per-player channels for the side to move."""
+    from .features import P_LADDERS
+
+    n = len(packed)
+    idx = np.arange(n)
+    mine, theirs = players - 1, 2 - players
+    flat = lambda ch: packed[idx, ch].reshape(n, -1).astype(np.int64)  # noqa: E731
+    return (flat(P_KILLS + mine), flat(P_KILLS + theirs),
+            flat(P_LIB_AFTER + mine), flat(P_LIB_AFTER + theirs),
+            flat(P_LADDERS + mine))
+
+
 def _oneply_scores(packed: np.ndarray,
                    players: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """OnePlyAgent's tactical evaluation as two (n, 361) int64 grids.
@@ -150,18 +179,11 @@ def _oneply_scores(packed: np.ndarray,
     can reach hundreds next to a big group). Shared by OnePlyAgent
     (argmax of ``score`` over all legal points) and PolicySearchAgent
     (re-ranking of policy candidates; urgency from ``forcing``)."""
-    from .features import P_LADDERS
-
-    n = len(packed)
-    idx = np.arange(n)
-    mine, theirs = players - 1, 2 - players
-    my_kills = packed[idx, P_KILLS + mine].reshape(n, -1).astype(np.int64)
-    opp_kills = packed[idx, P_KILLS + theirs].reshape(n, -1).astype(np.int64)
-    my_libs = packed[idx, P_LIB_AFTER + mine].reshape(n, -1).astype(np.int64)
-    opp_libs = packed[idx, P_LIB_AFTER + theirs].reshape(n, -1).astype(np.int64)
-    ladders = packed[idx, P_LADDERS + mine].reshape(n, -1).astype(np.int64)
-    forcing = 1000 * my_kills + 700 * opp_kills + 400 * ladders
-    score = (forcing + 12 * my_libs + 6 * opp_libs - 900 * (my_libs <= 1))
+    my_kills, opp_kills, my_libs, opp_libs, ladders = _tactical_grids(
+        packed, players)
+    forcing = W_KILL * my_kills + W_SAVE * opp_kills + W_LADDER * ladders
+    score = (forcing + W_LIB * my_libs + W_OPP_LIB * opp_libs
+             - W_SELF_ATARI * (my_libs <= 1))
     return score, forcing
 
 
@@ -315,14 +337,29 @@ class TwoPlyAgent(PolicySearchAgent):
          §Conclusion — the same pruning role the paper projects),
       2. PLAYS each candidate on a copy of the board (batched native move
          application across the whole fleet x candidate set), and
-      3. scores it as the 1-ply tactical gain now MINUS the opponent's best
-         forcing response on the resulting board (capture/save/ladder
-         component of ``_oneply_scores``, ko-banned reply excluded) —
-         so snapbacks, self-ataris beyond the immediate stone, and
-         captures that hand back a bigger recapture are all seen, which
-         the purely-static OnePlyAgent cannot do (reference analogue:
-         count_kills_and_liberties, makedata.lua:304-327, is exactly one
-         hypothetical ply deep).
+      3. scores it by REALIZED outcome: the captures/ladders/liberty shape
+         the move itself achieves, minus the material the opponent's best
+         reply takes on the resulting board (immediate captures + working
+         ladders, ko-banned reply excluded) — so snapbacks, self-ataris
+         beyond the immediate stone, and captures that hand back a bigger
+         recapture are all seen, which the purely-static OnePlyAgent
+         cannot do (reference analogue: count_kills_and_liberties,
+         makedata.lua:304-327, is exactly one hypothetical ply deep).
+
+    Deliberately NOT in a candidate's own gain: the 1-ply 700-point
+    "save" term (``_oneply_scores``' opponent-kills channel). A save is
+    speculative — it only worked if the capture threat is actually gone
+    from the after-board, which is exactly what the threat term measures.
+    Crediting saves up front made the first build of this agent chase
+    doomed groups (save k stones -> still capturable as k+1 -> save again
+    ...), escalating the horizon effect until it lost every head-to-head
+    game against the 1-ply veto agent with half the matches hitting the
+    move cap (0/200, measured round 4). Under realized-outcome scoring a
+    futile save scores ~-1000(k+1) while the quiet policy move scores
+    ~-1000k: giving the group up is correctly preferred, and a WORKING
+    save (threat drops to zero) fires on its own merits. Pre-existing
+    threats cancel out of the differential veto entirely — both sides of
+    the comparison face the same standing board.
 
     The policy keeps the move unless its own candidate is REFUTED: the best
     candidate must beat the policy move's 2-ply score by ``margin``
@@ -345,7 +382,7 @@ class TwoPlyAgent(PolicySearchAgent):
 
         legal = _no_own_eyes(packed, players, legal)
         logp = self._legal_log_probs(packed, players, legal)
-        tact1, forcing1 = _oneply_scores(packed, players)
+        _, forcing1 = _oneply_scores(packed, players)
         n = len(packed)
         any_legal = legal.any(axis=1)
         policy_move = np.where(any_legal, logp.argmax(axis=1), -1)
@@ -359,24 +396,35 @@ class TwoPlyAgent(PolicySearchAgent):
         if rows.size == 0:
             return policy_move
 
-        # play every candidate on a board copy, measure the opponent's best
-        # forcing reply on each resulting position
+        # realized 1-ply gain: captures, working ladders, liberty shape —
+        # WITHOUT the speculative save term (see class docstring)
+        my_kills, _, my_libs, opp_libs, ladders = _tactical_grids(
+            packed, players)
+        gain = (W_KILL * my_kills + W_LADDER * ladders + W_LIB * my_libs
+                + W_OPP_LIB * opp_libs - W_SELF_ATARI * (my_libs <= 1))
+
+        # play every candidate on a board copy, measure the material the
+        # opponent's best legal reply actually takes on each after-board
+        # (immediate captures + working ladders; ko-banned reply excluded)
         stones = packed[rows, P_STONES].astype(np.uint8).copy()
         age = packed[rows, P_AGE].astype(np.int32)
         after, ko = _apply_and_summarize(stones, age, cols.astype(np.int32),
                                          players[rows].astype(np.int32))
         opp = (3 - players[rows]).astype(np.int32)
-        _, forcing_reply = _oneply_scores(after, opp)
+        midx = np.arange(len(rows))
+        reply_kills, _, _, _, reply_ladders = _tactical_grids(after, opp)
+        reply_take = W_KILL * reply_kills + W_LADDER * reply_ladders
         reply_legal = legal_mask(after, opp)
-        flat = np.arange(len(rows))
         banned = ko >= 0
-        reply_legal[flat[banned], ko[banned]] = False
-        threat = np.where(reply_legal, forcing_reply, 0).max(axis=1)
+        reply_legal[midx[banned], ko[banned]] = False
+        threat = np.where(reply_legal, reply_take, 0).max(axis=1)
 
-        # 2-ply score: my tactical gain minus the best response I allow;
+        # realized-outcome 2-ply score: what the move takes minus what the
+        # best reply takes back; standing threats hit every candidate's
+        # after-board alike and so cancel out of the differential below.
         # policy prob in (0,1] + sub-ulp noise breaks integer-tier ties
         score2 = np.full((n, logp.shape[1]), -np.inf)
-        score2[rows, cols] = tact1[rows, cols].astype(np.float64) - threat
+        score2[rows, cols] = gain[rows, cols].astype(np.float64) - threat
         score2 += np.where(cand, np.exp(logp) + rng.random(logp.shape) * 1e-9,
                            0.0)
         best2 = score2.argmax(axis=1)

@@ -235,6 +235,15 @@ def test_bad_batch_postmortem_capture(data_root, tmp_path):
     assert dump["packed"].shape == (cfg.batch_size, 9, 19, 19)
 
 
+def test_unknown_wire_format_rejected(data_root, tmp_path):
+    # a typo'd wire_format must fail loudly at init, not silently run the
+    # packed (2x-bytes) path with a bogus label
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"),
+                      wire_format="nible")
+    with pytest.raises(ValueError, match="wire_format"):
+        Experiment(cfg).init()
+
+
 def test_evaluate_full_split(data_root, tmp_path):
     cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
     exp = Experiment(cfg)

@@ -116,18 +116,22 @@ if [ -n "${I3P:-}" ]; then
   cpu_match "search2:$I3P" oneply iter3p_twoply_oneply
 fi
 
-# --- verdict item 5: augmentation's measured payoff (40k budget arm) ---
-if [ ! -f runs/r4logs/done_augment ]; then
-  stage augment
-  nice -n $N timeout 28800 python -u tools/accuracy_curve.py \
-    --data-root $CORPUS --budgets 40000 --iters 1500 \
-    --out docs/accuracy_curve_augment.jsonl \
-    --set num_layers=3 channels=64 batch_size=256 augment=true \
-    >> runs/r4logs/augment.log 2>&1 \
-  && touch runs/r4logs/done_augment
-  echo "augment rc=$?"
-  tail -1 docs/accuracy_curve_augment.jsonl 2>/dev/null
-fi
+# --- verdict item 5: augmentation's measured payoff (40k budget) ---
+# both arms on THIS round's corpus realization so the comparison is
+# clean (the round-3 curve row used the round-3 realization)
+for aug in false true; do
+  if [ ! -f runs/r4logs/done_augment_$aug ]; then
+    stage "augment=$aug"
+    nice -n $N timeout 28800 python -u tools/accuracy_curve.py \
+      --data-root $CORPUS --budgets 40000 --iters 1500 \
+      --out docs/accuracy_curve_augment_$aug.jsonl \
+      --set num_layers=3 channels=64 batch_size=256 augment=$aug \
+      >> runs/r4logs/augment.log 2>&1 \
+    && touch runs/r4logs/done_augment_$aug
+    echo "augment=$aug rc=$?"
+    tail -1 docs/accuracy_curve_augment_$aug.jsonl 2>/dev/null
+  fi
+done
 
 # --- verdict item 8: multi-seed warm-restart sweep demo ---
 if [ ! -f docs/restart_sweep.png ]; then
