@@ -169,8 +169,8 @@ def _tactical_grids(packed: np.ndarray, players: np.ndarray):
             flat(P_LADDERS + mine))
 
 
-def _oneply_scores(packed: np.ndarray,
-                   players: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+def _oneply_scores(packed: np.ndarray, players: np.ndarray,
+                   grids=None) -> tuple[np.ndarray, np.ndarray]:
     """OnePlyAgent's tactical evaluation as two (n, 361) int64 grids.
 
     Returns ``(score, forcing)``: the full evaluation, and its
@@ -178,9 +178,11 @@ def _oneply_scores(packed: np.ndarray,
     genuinely forcing move, free of the positional liberty terms (which
     can reach hundreds next to a big group). Shared by OnePlyAgent
     (argmax of ``score`` over all legal points) and PolicySearchAgent
-    (re-ranking of policy candidates; urgency from ``forcing``)."""
-    my_kills, opp_kills, my_libs, opp_libs, ladders = _tactical_grids(
-        packed, players)
+    (re-ranking of policy candidates; urgency from ``forcing``). Pass
+    ``grids`` (a ``_tactical_grids`` result) to reuse planes the caller
+    already extracted."""
+    my_kills, opp_kills, my_libs, opp_libs, ladders = (
+        grids if grids is not None else _tactical_grids(packed, players))
     forcing = W_KILL * my_kills + W_SAVE * opp_kills + W_LADDER * ladders
     score = (forcing + W_LIB * my_libs + W_OPP_LIB * opp_libs
              - W_SELF_ATARI * (my_libs <= 1))
@@ -382,7 +384,8 @@ class TwoPlyAgent(PolicySearchAgent):
 
         legal = _no_own_eyes(packed, players, legal)
         logp = self._legal_log_probs(packed, players, legal)
-        _, forcing1 = _oneply_scores(packed, players)
+        grids = _tactical_grids(packed, players)
+        _, forcing1 = _oneply_scores(packed, players, grids)
         n = len(packed)
         any_legal = legal.any(axis=1)
         policy_move = np.where(any_legal, logp.argmax(axis=1), -1)
@@ -398,8 +401,7 @@ class TwoPlyAgent(PolicySearchAgent):
 
         # realized 1-ply gain: captures, working ladders, liberty shape —
         # WITHOUT the speculative save term (see class docstring)
-        my_kills, _, my_libs, opp_libs, ladders = _tactical_grids(
-            packed, players)
+        my_kills, _, my_libs, opp_libs, ladders = grids
         gain = (W_KILL * my_kills + W_LADDER * ladders + W_LIB * my_libs
                 + W_OPP_LIB * opp_libs - W_SELF_ATARI * (my_libs <= 1))
 
@@ -449,12 +451,22 @@ class TwoPlyAgent(PolicySearchAgent):
 
 
 def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
-               komi: float = 7.5, max_moves: int = 450, seed: int = 0):
+               komi: float = 7.5, max_moves: int = 450, seed: int = 0,
+               opening_plies: int = 0):
     """Run n_games with alternating colors; returns (games, scores, stats).
 
     Game i gives black to agent_a when i is even. Every active game advances
     one ply per iteration, so all active boards share a side-to-move and each
     agent sees at most one batch per ply.
+
+    ``opening_plies > 0`` starts each game with that many uniformly-random
+    legal moves before the agents take over, with games 2i and 2i+1
+    SHARING an opening (the color-swapped rematch starts from the same
+    position). Two deterministic agents otherwise produce one pair of
+    games replicated n_games/2 times — sub-ulp tie-break noise almost
+    never flips a trained net's argmax — so a 200-game match carries two
+    games' worth of evidence; balanced random openings restore n_games
+    distinct trajectories while keeping the color-paired fairness.
     """
     rng = np.random.default_rng(seed)
     games = [GameState() for _ in range(n_games)]
@@ -474,13 +486,24 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
         plies += len(live)
 
         moves = np.full(len(live), -1, dtype=np.int64)
-        agents = (agent_a,) if agent_b is agent_a else (agent_a, agent_b)
-        for agent in agents:
-            sel = [j for j, i in enumerate(live)
-                   if agent_of[i][games[i].player - 1] is agent]
-            if sel:
-                moves[sel] = agent.select_moves(
-                    packed[sel], players[sel], legal[sel], rng)
+        if len(games[live[0]].moves) < opening_plies:
+            # balanced random opening: draw one legal point per PAIR and
+            # give it to both color assignments (identical positions, so
+            # one draw is legal in both)
+            u = rng.random(legal.shape)
+            pick = np.where(legal, u, -1.0).argmax(axis=1)
+            pick = np.where(legal.any(axis=1), pick, -1)
+            for j, i in enumerate(live):
+                mate = live.index(i ^ 1) if (i ^ 1) in live else j
+                moves[j] = pick[min(j, mate)]
+        else:
+            agents = (agent_a,) if agent_b is agent_a else (agent_a, agent_b)
+            for agent in agents:
+                sel = [j for j, i in enumerate(live)
+                       if agent_of[i][games[i].player - 1] is agent]
+                if sel:
+                    moves[sel] = agent.select_moves(
+                        packed[sel], players[sel], legal[sel], rng)
 
         step_games([games[i] for i in live], moves.tolist(), max_moves)
 
@@ -580,6 +603,12 @@ def main(argv=None) -> None:
                     help="dan rank fed to policy agents' rank planes; match "
                          "the training corpus (e.g. 8 for the synthetic "
                          "corpus, whose strongest games are tagged 8d)")
+    ap.add_argument("--opening-plies", type=int, default=0,
+                    help="start each game pair from this many shared "
+                         "uniformly-random legal moves — restores distinct "
+                         "trajectories in deterministic-vs-deterministic "
+                         "matches (the color-swapped rematch shares the "
+                         "opening, keeping the pairing fair)")
     ap.add_argument("--sgf-out", help="directory to write scored games")
     args = ap.parse_args(argv)
 
@@ -590,7 +619,8 @@ def main(argv=None) -> None:
     agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank)
     games, scores, stats = play_match(agent_a, agent_b, n_games=args.games,
                                       komi=args.komi, max_moves=args.max_moves,
-                                      seed=args.seed)
+                                      seed=args.seed,
+                                      opening_plies=args.opening_plies)
     print({k: round(v, 3) if isinstance(v, float) else v
            for k, v in stats.items()})
 

@@ -120,6 +120,17 @@ class TestArena:
         assert stats["games"] == 2
         assert all(g.done for g in games)
 
+    def test_opening_plies_paired_and_distinct(self):
+        # two deterministic agents, 4 games, 6-ply random openings: games
+        # 2i/2i+1 share their opening exactly (balanced color swap) while
+        # the two pairs get different openings (distinct trajectories)
+        games, _, _ = arena.play_match(
+            arena.OnePlyAgent(), arena.HeuristicAgent(), n_games=4,
+            max_moves=30, seed=5, opening_plies=6)
+        op = [[(m.x, m.y) for m in g.moves[:6]] for g in games]
+        assert op[0] == op[1] and op[2] == op[3]
+        assert op[0] != op[2]
+
     def test_scored_sgf_roundtrip(self):
         games, scores, _ = arena.play_match(
             arena.RandomAgent(), arena.RandomAgent(),

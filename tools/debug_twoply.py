@@ -23,13 +23,18 @@ class DebugTwoPly(arena.TwoPlyAgent):
                           fire_tact=[])
 
     def select_moves(self, packed, players, legal, rng):
-        from deepgo_tpu.features import P_AGE, P_STONES
-
         moves = super().select_moves(packed, players, legal, rng)
-        # re-derive the internals for accounting (cheap at debug scale)
+        # re-derive the internals for accounting (cheap at debug scale);
+        # report the REALIZED gain the fixed agent scores with (no
+        # speculative save credit), not _oneply_scores' save-inflated tact
         legal2 = arena._no_own_eyes(packed, players, legal)
         logp = self._legal_log_probs(packed, players, legal2)
-        tact1, forcing1 = arena._oneply_scores(packed, players)
+        my_kills, _, my_libs, opp_libs, ladders = arena._tactical_grids(
+            packed, players)
+        tact1 = (arena.W_KILL * my_kills + arena.W_LADDER * ladders
+                 + arena.W_LIB * my_libs + arena.W_OPP_LIB * opp_libs
+                 - arena.W_SELF_ATARI * (my_libs <= 1))
+        _, forcing1 = arena._oneply_scores(packed, players)
         any_legal = legal2.any(axis=1)
         policy_move = np.where(any_legal, logp.argmax(axis=1), -1)
         n = len(packed)
