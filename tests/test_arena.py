@@ -486,6 +486,30 @@ class TestTwoPlyAgent:
         # (0,4) leaves the chain still capturable (threat stays high)
         assert move == 0 * 19 + 1
 
+    def test_futile_save_does_not_fire(self):
+        # regression for the round-4 horizon-effect collapse: a 4-stone
+        # black chain in atari whose only "save" (0,0) leaves the bigger
+        # chain still in atari (white recaptures 5 at (1,0)). Under the
+        # old save-credited scoring the save carried 700*4 of speculative
+        # credit and outscored every quiet move by ~900 >= margin, so the
+        # agent chased the doomed group; realized-outcome scoring must
+        # keep the policy's own move instead
+        g = arena.GameState()
+        for y in (1, 2, 3, 4):
+            play(g.stones, g.age, 0, y, BLACK)
+            play(g.stones, g.age, 1, y, WHITE)
+        play(g.stones, g.age, 0, 5, WHITE)   # cap: chain liberty = (0,0) only
+        g.player = 1
+        packed, players, legal = self._position(g)
+        agent = self._agent(top_k=1)
+        masked = arena._no_own_eyes(packed, players, legal)
+        logp = agent._legal_log_probs(packed, players, masked)
+        policy_move = int(logp[0].argmax())
+        assert policy_move != 0, "vacuous fixture: policy argmax is the save"
+        move = agent.select_moves(packed, players, legal,
+                                  np.random.default_rng(0))[0]
+        assert move == policy_move
+
     def test_urgent_capture_vetoes_pass(self):
         # pass_threshold=2.0 is unsatisfiable, so the policy rule alone
         # would always pass; with a live capture on the board the agent
