@@ -327,6 +327,65 @@ def _apply_and_summarize(stones: np.ndarray, age: np.ndarray,
     return packed, ko
 
 
+def _play_candidates(packed, players, legal, logp, forcing, top_k,
+                     urgent_threshold):
+    """Candidate set + played after-boards, shared by every deep searcher.
+
+    Returns ``(urgent, cand, rows, cols, after, ko)``: the forcing-point
+    mask, the candidate mask (policy top-k | urgent), the candidates in
+    nonzero order, and each candidate's after-board + ko point (``after``
+    is None when no board has a candidate). One definition so the
+    candidate-set rule cannot drift between search agents.
+    """
+    from .features import P_AGE, P_STONES
+
+    urgent = legal & (forcing >= urgent_threshold)
+    cand = _topk_mask(logp, legal, top_k) | urgent
+    rows, cols = np.nonzero(cand)
+    if rows.size == 0:
+        return urgent, cand, rows, cols, None, None
+    stones = packed[rows, P_STONES].astype(np.uint8).copy()
+    age = packed[rows, P_AGE].astype(np.int32)
+    after, ko = _apply_and_summarize(stones, age, cols.astype(np.int32),
+                                     players[rows].astype(np.int32))
+    return urgent, cand, rows, cols, after, ko
+
+
+def _veto_select(logp, legal, cand, rows, cols, cand_scores, margin, urgent,
+                 pass_threshold, rng, tie_scale=1.0):
+    """Differential-veto move selection, shared by every deep searcher.
+
+    ``cand_scores`` aligns with (rows, cols). The policy argmax is kept
+    unless some candidate beats ITS score by ``margin``; the pass rule is
+    PolicySearchAgent's (policy below threshold, nothing forcing, veto not
+    firing). ``tie_scale`` sizes the policy-prob tie-break relative to the
+    score units (1.0 for integer tactical tiers, sub-margin for win-prob
+    scores).
+    """
+    n, p = logp.shape
+    any_legal = legal.any(axis=1)
+    policy_move = np.where(any_legal, logp.argmax(axis=1), -1)
+    score = np.full((n, p), -np.inf)
+    score[rows, cols] = cand_scores
+    score += np.where(cand,
+                      tie_scale * (np.exp(logp) + rng.random(logp.shape)
+                                   * 1e-9),
+                      0.0)
+    best = score.argmax(axis=1)
+    best_val = score.max(axis=1)
+    pol_val = np.where(any_legal, score[np.arange(n), policy_move], -np.inf)
+    fire = any_legal & (best_val >= pol_val + margin)
+    moves = np.where(fire, best, policy_move)
+    # pass exactly when PolicySearchAgent would: policy below the pass
+    # threshold AND nothing forcing on the board AND no override. Without
+    # the urgency veto, a settled endgame whose argmax IS a live capture
+    # would pass over dead stones and hand them to the opponent under
+    # area scoring.
+    best_p = np.exp(logp.max(axis=1, initial=-np.inf))
+    do_pass = (best_p < pass_threshold) & ~fire & ~urgent.any(axis=1)
+    return np.where(do_pass, -1, moves)
+
+
 class TwoPlyAgent(PolicySearchAgent):
     """Policy-pruned 2-ply search: candidates from the net, replies refuted.
 
@@ -380,24 +439,15 @@ class TwoPlyAgent(PolicySearchAgent):
         self.margin = margin
 
     def select_moves(self, packed, players, legal, rng):
-        from .features import P_AGE, P_STONES
-
         legal = _no_own_eyes(packed, players, legal)
         logp = self._legal_log_probs(packed, players, legal)
         grids = _tactical_grids(packed, players)
         _, forcing1 = _oneply_scores(packed, players, grids)
-        n = len(packed)
-        any_legal = legal.any(axis=1)
-        policy_move = np.where(any_legal, logp.argmax(axis=1), -1)
-
-        # candidate set: policy top-k (includes its argmax) + forcing moves;
-        # bound once so the candidate set and the pass-veto below cannot
-        # drift apart if the urgency rule changes
-        urgent = legal & (forcing1 >= self.urgent)
-        cand = _topk_mask(logp, legal, self.top_k) | urgent
-        rows, cols = np.nonzero(cand)
-        if rows.size == 0:
-            return policy_move
+        urgent, cand, rows, cols, after, ko = _play_candidates(
+            packed, players, legal, logp, forcing1, self.top_k, self.urgent)
+        if after is None:
+            any_legal = legal.any(axis=1)
+            return np.where(any_legal, logp.argmax(axis=1), -1)
 
         # realized 1-ply gain: captures, working ladders, liberty shape —
         # WITHOUT the speculative save term (see class docstring)
@@ -405,13 +455,9 @@ class TwoPlyAgent(PolicySearchAgent):
         gain = (W_KILL * my_kills + W_LADDER * ladders + W_LIB * my_libs
                 + W_OPP_LIB * opp_libs - W_SELF_ATARI * (my_libs <= 1))
 
-        # play every candidate on a board copy, measure the material the
-        # opponent's best legal reply actually takes on each after-board
-        # (immediate captures + working ladders; ko-banned reply excluded)
-        stones = packed[rows, P_STONES].astype(np.uint8).copy()
-        age = packed[rows, P_AGE].astype(np.int32)
-        after, ko = _apply_and_summarize(stones, age, cols.astype(np.int32),
-                                         players[rows].astype(np.int32))
+        # measure the material the opponent's best legal reply actually
+        # takes on each after-board (immediate captures + working ladders;
+        # ko-banned reply excluded)
         opp = (3 - players[rows]).astype(np.int32)
         midx = np.arange(len(rows))
         reply_kills, _, _, _, reply_ladders = _tactical_grids(after, opp)
@@ -423,31 +469,76 @@ class TwoPlyAgent(PolicySearchAgent):
 
         # realized-outcome 2-ply score: what the move takes minus what the
         # best reply takes back; standing threats hit every candidate's
-        # after-board alike and so cancel out of the differential below.
-        # policy prob in (0,1] + sub-ulp noise breaks integer-tier ties
-        score2 = np.full((n, logp.shape[1]), -np.inf)
-        score2[rows, cols] = gain[rows, cols].astype(np.float64) - threat
-        score2 += np.where(cand, np.exp(logp) + rng.random(logp.shape) * 1e-9,
-                           0.0)
-        best2 = score2.argmax(axis=1)
-        best2_val = score2.max(axis=1)
-        pol_val = np.where(any_legal,
-                           score2[np.arange(n), policy_move], -np.inf)
+        # after-board alike and so cancel out of the differential veto
+        return _veto_select(logp, legal, cand, rows, cols,
+                            gain[rows, cols].astype(np.float64) - threat,
+                            self.margin, urgent, self.pass_threshold, rng)
 
-        # differential veto: override only when the policy's move is
-        # refuted at 2 ply by a full tactical margin
-        fire = any_legal & (best2_val >= pol_val + self.margin)
-        moves = np.where(fire, best2, policy_move)
 
-        # pass exactly when PolicySearchAgent would: policy below the pass
-        # threshold AND nothing forcing on the board. Without the urgency
-        # veto, a settled endgame whose argmax IS a live capture (fire
-        # stays False — the differential is zero) would pass over dead
-        # stones and hand them to the opponent under area scoring.
-        has_urgent = urgent.any(axis=1)
-        best_p = np.exp(logp.max(axis=1, initial=-np.inf))
-        do_pass = (best_p < self.pass_threshold) & ~fire & ~has_urgent
-        return np.where(do_pass, -1, moves)
+class ValueSearchAgent(PolicySearchAgent):
+    """Policy-pruned 1-ply search over a LEARNED evaluation (``value:`` spec).
+
+    The round-4 expert-iteration study's conclusion (RESULTS.md): a
+    constant tactical wrapper saturates the self-improvement loop after
+    one distillation round — climbing further needs an evaluation whose
+    quality grows with training. This agent is that next rung's
+    scaffold: candidates are the policy's top-k plus every forcing
+    point (the same pruning as the tactical searchers), each candidate
+    is PLAYED (batched native stepping), and the score is the value
+    network's win probability for the mover on the after-board
+    (1 - P(opponent-to-move wins), models/value_cnn.py). The
+    differential veto fires only when some candidate beats the policy
+    move's own after-board value by ``margin`` win-probability (default
+    0.08) — the same only-override-demonstrated-blunders asymmetry the
+    tactical sweeps showed is optimal.
+
+    Known approximations, documented not hidden: the value net does not
+    see the ko ban on the after-board, and a net trained on
+    mixed-rank corpora can lean on the rank planes (equal-rank matches
+    force it onto board features).
+    """
+
+    name = "value-search"
+
+    def __init__(self, params, cfg, value_params, value_cfg,
+                 name: str = "value-search", margin: float = 0.08, **kw):
+        from .models.serving import make_value_fn
+
+        super().__init__(params, cfg, name=name, **kw)
+        self.value_params = value_params
+        self.value_cfg = value_cfg
+        self.margin = margin
+        self._win_prob = make_value_fn(value_cfg)
+
+    def select_moves(self, packed, players, legal, rng):
+        legal = _no_own_eyes(packed, players, legal)
+        logp = self._legal_log_probs(packed, players, legal)
+        _, forcing1 = _oneply_scores(packed, players)
+        urgent, cand, rows, cols, after, _ = _play_candidates(
+            packed, players, legal, logp, forcing1, self.top_k, self.urgent)
+        if after is None:
+            any_legal = legal.any(axis=1)
+            return np.where(any_legal, logp.argmax(axis=1), -1)
+
+        # candidate counts vary every ply; pad to the next power of two so
+        # the jitted value forward sees O(log n) distinct shapes (the same
+        # guard as selfplay.batched_log_probs)
+        n_c = len(rows)
+        cap = 1 << max(0, n_c - 1).bit_length() if n_c > 1 else 1
+        opp = (3 - players[rows]).astype(np.int32)
+        ranks = np.full(n_c, self.rank, dtype=np.int32)
+        if cap > n_c:
+            after = np.concatenate(
+                [after, np.zeros((cap - n_c,) + after.shape[1:], after.dtype)])
+            opp = np.concatenate([opp, np.ones(cap - n_c, opp.dtype)])
+            ranks = np.concatenate([ranks, np.ones(cap - n_c, ranks.dtype)])
+        v_opp = np.asarray(self._win_prob(self.value_params, after, opp,
+                                          ranks))[:n_c]
+        # tie_scale keeps the policy-prob tie-break under the win-prob
+        # margin, preserving the prior's ordering among value-equal moves
+        return _veto_select(logp, legal, cand, rows, cols, 1.0 - v_opp,
+                            self.margin, urgent, self.pass_threshold, rng,
+                            tie_scale=1e-4)
 
 
 def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
@@ -573,6 +664,19 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
 
         _, params, cfg = load_policy(spec.split(":", 1)[1])
         return TwoPlyAgent(params, cfg, rank=rank)
+    if spec.startswith("value:"):
+        from .models.serving import load_policy, load_value
+
+        # value:POLICY_CKPT:VALUE_CKPT — policy prunes, value net scores
+        try:
+            _, policy_path, value_path = spec.split(":", 2)
+        except ValueError:
+            raise ValueError(
+                f"value spec needs two checkpoint paths, got {spec!r} "
+                "(use value:POLICY.npz:VALUE.npz)") from None
+        _, params, cfg = load_policy(policy_path)
+        _, vparams, vcfg = load_value(value_path)
+        return ValueSearchAgent(params, cfg, vparams, vcfg, rank=rank)
     if spec.startswith("model:"):  # random-init policy, for smoke runs
         cfg = policy_cnn.CONFIGS[spec.split(":", 1)[1]]
         params = policy_cnn.init(jax.random.key(seed), cfg)
@@ -581,7 +685,7 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
     raise ValueError(
         f"unknown agent spec {spec!r} "
         "(use random | heuristic | oneply | checkpoint:PATH | search:PATH "
-        "| search2:PATH | model:NAME)")
+        "| search2:PATH | value:POLICY:VALUE | model:NAME)")
 
 
 def main(argv=None) -> None:

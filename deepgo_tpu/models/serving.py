@@ -49,3 +49,34 @@ def load_policy(checkpoint_path: str, top_k: int = 5):
     template = policy_cnn.init(jax.random.key(0), cfg)
     params = ckpt.unflatten_like(template, [jnp.asarray(x) for x in p_leaves])
     return make_policy_fn(cfg, top_k=top_k), params, cfg
+
+
+def make_value_fn(cfg):
+    """win_prob(params, packed, player, rank) -> (B,) P(side to move wins),
+    the value-net serving twin of make_policy_fn."""
+    from . import value_cnn
+
+    expand_planes = get_expand_fn("xla")
+
+    @jax.jit
+    def win_prob(params, packed, player, rank):
+        planes = expand_planes(packed, player, rank,
+                               dtype=jnp.dtype(cfg.compute_dtype))
+        return jax.nn.sigmoid(value_cnn.apply(params, planes, cfg))
+
+    return win_prob
+
+
+def load_value(checkpoint_path: str):
+    """(win_prob_fn, params, value_cfg) from a tools/train_value.py
+    checkpoint (kind="value")."""
+    from ..experiments import checkpoint as ckpt
+    from . import value_cnn
+
+    meta, p_leaves, _ = ckpt.load_checkpoint(checkpoint_path)
+    assert meta.get("kind") == "value", (
+        f"{checkpoint_path} is not a value checkpoint: {meta.get('kind')!r}")
+    cfg = value_cnn.ValueConfig(**meta["config"])
+    template = value_cnn.init(jax.random.key(0), cfg)
+    params = ckpt.unflatten_like(template, [jnp.asarray(x) for x in p_leaves])
+    return make_value_fn(cfg), params, cfg

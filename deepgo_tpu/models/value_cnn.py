@@ -1,0 +1,107 @@
+"""Convolutional value network: position -> P(side to move wins).
+
+The reference (and arXiv:1412.6564) is policy-only; this head is the
+framework's step toward value-guided search, motivated by the round-4
+expert-iteration finding that a constant tactical wrapper saturates the
+self-improvement loop after one distillation round (RESULTS.md) — the
+next expert up needs an evaluation whose quality grows with training,
+i.e. a learned value function (the direction the paper's successors
+took: AlphaGo's value network, Silver et al. 2016).
+
+Architecture: the same SAME-padded conv trunk as the policy net (5x5
+then 3x3 convs, per-position biases, ReLU, bf16 on the MXU), then a
+1x1 conv to one channel, a 64-unit dense layer over the 361 board
+values, and a scalar logit. Input is the identical 37-plane encoding
+(`ops/expand`), so the host pipeline, wire formats, and summarizer are
+shared with the policy path unchanged.
+
+Functional design mirrors policy_cnn: ``init`` -> params pytree,
+``apply(params, planes) -> (B,) logits``, jit/grad-compatible. Labels
+come from the winner sidecar (`tools/winner_index.py`): z=1 when the
+side to move won the game the position came from.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import BOARD_SIZE, NUM_POINTS
+from ..features import NUM_PLANES
+
+
+@dataclass(frozen=True)
+class ValueConfig:
+    """``num_layers`` counts the trunk convolutions (all hidden; the head's
+    1x1 conv is separate, unlike policy_cnn where the final conv IS the
+    output)."""
+
+    num_layers: int = 3
+    channels: int = 64
+    first_kernel: int = 5
+    kernel: int = 3
+    input_planes: int = NUM_PLANES
+    head_hidden: int = 64
+    compute_dtype: str = "bfloat16"
+
+    def layer_shapes(self):
+        shapes = []
+        c_in = self.input_planes
+        for i in range(self.num_layers):
+            k = self.first_kernel if i == 0 else self.kernel
+            shapes.append((k, c_in, self.channels))
+            c_in = self.channels
+        return shapes
+
+
+def init(rng: jax.Array, cfg: ValueConfig) -> dict:
+    """He-normal conv/dense weights, zero biases (policy_cnn.init style)."""
+    params = {"layers": []}
+    for k, c_in, c_out in cfg.layer_shapes():
+        rng, wkey = jax.random.split(rng)
+        w = jax.random.normal(wkey, (k, k, c_in, c_out), jnp.float32)
+        w = w * np.sqrt(2.0 / (k * k * c_in))
+        b = jnp.zeros((BOARD_SIZE, BOARD_SIZE, c_out), jnp.float32)
+        params["layers"].append({"w": w, "b": b})
+    rng, k1, k2, k3 = jax.random.split(rng, 4)
+    params["head_conv"] = {
+        "w": jax.random.normal(k1, (1, 1, cfg.channels, 1), jnp.float32)
+        * np.sqrt(2.0 / cfg.channels),
+        "b": jnp.zeros((BOARD_SIZE, BOARD_SIZE, 1), jnp.float32),
+    }
+    params["dense1"] = {
+        "w": jax.random.normal(k2, (NUM_POINTS, cfg.head_hidden), jnp.float32)
+        * np.sqrt(2.0 / NUM_POINTS),
+        "b": jnp.zeros((cfg.head_hidden,), jnp.float32),
+    }
+    params["dense2"] = {
+        "w": jax.random.normal(k3, (cfg.head_hidden, 1), jnp.float32)
+        * np.sqrt(2.0 / cfg.head_hidden),
+        "b": jnp.zeros((1,), jnp.float32),
+    }
+    return params
+
+
+def apply(params: dict, planes: jax.Array, cfg: ValueConfig) -> jax.Array:
+    """planes: (B, 19, 19, 37) -> win-probability logits (B,)."""
+    dtype = jnp.dtype(cfg.compute_dtype)
+    x = planes.astype(dtype)
+    for layer in params["layers"]:
+        x = jax.lax.conv_general_dilated(
+            x, layer["w"].astype(dtype), window_strides=(1, 1),
+            padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + layer["b"].astype(dtype)[None])
+    hc = params["head_conv"]
+    x = jax.lax.conv_general_dilated(
+        x, hc["w"].astype(dtype), window_strides=(1, 1),
+        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    x = jax.nn.relu(x + hc["b"].astype(dtype)[None])
+    x = x.reshape(x.shape[0], NUM_POINTS)
+    d1 = params["dense1"]
+    x = jax.nn.relu(x @ d1["w"].astype(dtype) + d1["b"].astype(dtype))
+    d2 = params["dense2"]
+    logit = x @ d2["w"].astype(dtype) + d2["b"].astype(dtype)
+    return logit[:, 0].astype(jnp.float32)

@@ -383,6 +383,53 @@ class TestArena:
                 assert 0 <= move.x < 19 and 0 <= move.y < 19
 
 
+class TestValueSearchAgent:
+    @staticmethod
+    def _agent(**kw):
+        import jax
+
+        from deepgo_tpu.models import policy_cnn, value_cnn
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        vcfg = value_cnn.ValueConfig(num_layers=2, channels=8)
+        vparams = value_cnn.init(jax.random.key(1), vcfg)
+        return arena.ValueSearchAgent(params, cfg, vparams, vcfg, **kw)
+
+    def test_huge_margin_keeps_policy_argmax(self):
+        # an unreachable margin disables the veto entirely: the move must
+        # be exactly the policy argmax, whatever the value net thinks
+        agent = self._agent(margin=1e9)
+        g = arena.GameState()
+        play(g.stones, g.age, 10, 10, BLACK)
+        play(g.stones, g.age, 4, 15, WHITE)
+        g.player = 1
+        packed, players, legal = TestTwoPlyAgent._position(g)
+        masked = arena._no_own_eyes(packed, players, legal)
+        logp = agent._legal_log_probs(packed, players, masked)
+        move = agent.select_moves(packed, players, legal,
+                                  np.random.default_rng(0))[0]
+        assert move == int(logp[0].argmax())
+
+    def test_negative_margin_always_fires_to_value_argmax(self):
+        # margin -inf-ish means the veto always fires; the chosen move must
+        # be a legal candidate (value-argmax), exercising the full
+        # play-candidates -> value-forward -> override path
+        agent = self._agent(margin=-1e9, top_k=4)
+        g = arena.GameState()
+        play(g.stones, g.age, 3, 3, BLACK)
+        play(g.stones, g.age, 15, 15, WHITE)
+        g.player = 1
+        packed, players, legal = TestTwoPlyAgent._position(g)
+        move = agent.select_moves(packed, players, legal,
+                                  np.random.default_rng(0))[0]
+        assert move >= 0 and legal[0, move]
+
+    def test_value_spec_needs_two_paths(self):
+        with pytest.raises(ValueError, match="two checkpoint paths"):
+            arena._make_agent("value:only_one.npz", seed=0)
+
+
 class TestTwoPlyAgent:
     @staticmethod
     def _agent(**kw):

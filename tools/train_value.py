@@ -1,0 +1,153 @@
+"""Train the value network: position -> P(side to move wins).
+
+Labels come from the winner sidecar (tools/winner_index.py): every
+position whose game has a decided result gets z = 1 when the side to
+move won. Decided-game filtering, the 37-plane expansion, bf16 trunk,
+and the fused expand+forward+backward+update step all reuse the
+framework's existing pieces (GoDataset memmap shards, ops/expand,
+training/optimizers.sgd, experiments/checkpoint) — this tool only adds
+the batch loop and the BCE objective (models/value_cnn.py docstring for
+why the framework grows a value head at all).
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/train_value.py \
+      --data-root data/corpus/processed --iters 2000 --out runs/value
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepgo_tpu.utils import honor_platform_env  # noqa: E402
+
+honor_platform_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deepgo_tpu.data.dataset import GoDataset, M_BLACK_RANK, M_PLAYER, \
+    M_WHITE_RANK  # noqa: E402
+from deepgo_tpu.models import value_cnn  # noqa: E402
+from deepgo_tpu.ops.expand import expand_planes  # noqa: E402
+from deepgo_tpu.training.optimizers import sgd  # noqa: E402
+from deepgo_tpu.experiments.checkpoint import save_checkpoint  # noqa: E402
+
+
+def decided_indices(ds: GoDataset) -> np.ndarray:
+    assert ds.winner is not None, (
+        f"no winner.npy in {ds.dir} — run tools/winner_index.py first")
+    return np.nonzero(ds.winner != 0)[0]
+
+
+def gather(ds: GoDataset, idx: np.ndarray):
+    packed = np.asarray(ds.planes[idx])
+    meta = ds.meta[idx]
+    player = meta[:, M_PLAYER].astype(np.int32)
+    rank = np.where(player == 1, meta[:, M_BLACK_RANK],
+                    meta[:, M_WHITE_RANK]).astype(np.int32)
+    z = (ds.winner[idx] == player).astype(np.float32)
+    return packed, player, rank, z
+
+
+def make_step(cfg: value_cnn.ValueConfig, optimizer):
+    def loss_fn(params, packed, player, rank, z):
+        planes = expand_planes(packed, player, rank)
+        logits = value_cnn.apply(params, planes, cfg)
+        # mean sigmoid BCE in f32 (same upcast rule as the policy NLL)
+        return jnp.mean(jnp.maximum(logits, 0) - logits * z
+                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+    @jax.jit
+    def step(params, opt_state, packed, player, rank, z):
+        loss, grads = jax.value_and_grad(loss_fn)(params, packed, player,
+                                                  rank, z)
+        params, opt_state = optimizer.update(params, grads, opt_state)
+        return params, opt_state, loss
+
+    @jax.jit
+    def evaluate(params, packed, player, rank, z):
+        planes = expand_planes(packed, player, rank)
+        logits = value_cnn.apply(params, planes, cfg)
+        acc = jnp.mean(((logits > 0) == (z > 0.5)).astype(jnp.float32))
+        return loss_fn(params, packed, player, rank, z), acc
+
+    return step, evaluate
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--data-root", default="data/corpus/processed")
+    ap.add_argument("--iters", type=int, default=2000)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--rate", type=float, default=0.02)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--num-layers", type=int, default=3)
+    ap.add_argument("--channels", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--val-interval", type=int, default=500)
+    ap.add_argument("--val-size", type=int, default=4096)
+    ap.add_argument("--print-interval", type=int, default=100)
+    ap.add_argument("--out", default="runs/value")
+    args = ap.parse_args(argv)
+
+    cfg = value_cnn.ValueConfig(num_layers=args.num_layers,
+                                channels=args.channels)
+    train = GoDataset(args.data_root, "train")
+    val = GoDataset(args.data_root, "validation")
+    tr_idx = decided_indices(train)
+    va_idx = decided_indices(val)
+    rng = np.random.default_rng(args.seed)
+    va_batch = gather(val, rng.choice(va_idx, size=min(args.val_size,
+                                                       len(va_idx)),
+                                      replace=False))
+    print(f"train positions (decided games): {len(tr_idx):,} of "
+          f"{len(train):,}; val probe {len(va_batch[0]):,}", flush=True)
+
+    optimizer = sgd(args.rate, 0.0, args.momentum)
+    params = value_cnn.init(jax.random.key(args.seed), cfg)
+    opt_state = optimizer.init(params)
+    step, evaluate = make_step(cfg, optimizer)
+
+    os.makedirs(args.out, exist_ok=True)
+    history = []
+    ewma = None
+    t0 = time.time()
+    for i in range(1, args.iters + 1):
+        idx = rng.choice(tr_idx, size=args.batch_size)
+        packed, player, rank, z = gather(train, idx)
+        params, opt_state, loss = step(params, opt_state, packed, player,
+                                       rank, z)
+        if i % args.print_interval == 0:
+            loss = float(loss)
+            ewma = loss if ewma is None else 0.95 * ewma + 0.05 * loss
+            rate_s = i * args.batch_size / (time.time() - t0)
+            print(f"value training {ewma:.4f} "
+                  f"(samples per second {rate_s:.0f})", flush=True)
+        if i % args.val_interval == 0 or i == args.iters:
+            vl, va = evaluate(params, *va_batch)
+            history.append({"step": i, "val_loss": float(vl),
+                            "val_accuracy": float(va)})
+            print(f"value validation at {i}: loss={float(vl):.4f} "
+                  f"accuracy={float(va):.4f}", flush=True)
+    path = os.path.join(args.out, "value_checkpoint.npz")
+    save_checkpoint(path, params, opt_state, {
+        "kind": "value",
+        "config": {"num_layers": cfg.num_layers, "channels": cfg.channels,
+                   "head_hidden": cfg.head_hidden},
+        "step": args.iters,
+        "validation_history": history,
+    })
+    print(f"saved {path}")
+    print(json.dumps({"final": history[-1] if history else None}))
+
+
+if __name__ == "__main__":
+    main()
