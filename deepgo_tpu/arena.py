@@ -543,7 +543,7 @@ class ValueSearchAgent(PolicySearchAgent):
 
 def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
                komi: float = 7.5, max_moves: int = 450, seed: int = 0,
-               opening_plies: int = 0):
+               opening_plies: int = 0, shared_openings: bool = True):
     """Run n_games with alternating colors; returns (games, scores, stats).
 
     Game i gives black to agent_a when i is even. Every active game advances
@@ -558,6 +558,14 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
     never flips a trained net's argmax — so a 200-game match carries two
     games' worth of evidence; balanced random openings restore n_games
     distinct trajectories while keeping the color-paired fairness.
+
+    ``shared_openings=False`` draws an independent opening per GAME
+    instead of per pair. Win-rate evaluation wants the pair-shared
+    default (the color-swapped rematch from the same position is what
+    makes the pairing fair); corpus generation wants maximum trajectory
+    diversity — a deterministic agent playing itself from a pair-shared
+    opening produces the SAME game twice, and the duplicates can
+    straddle train/validation splits downstream.
     """
     rng = np.random.default_rng(seed)
     games = [GameState() for _ in range(n_games)]
@@ -585,8 +593,11 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
             pick = np.where(legal, u, -1.0).argmax(axis=1)
             pick = np.where(legal.any(axis=1), pick, -1)
             for j, i in enumerate(live):
-                mate = live.index(i ^ 1) if (i ^ 1) in live else j
-                moves[j] = pick[min(j, mate)]
+                if shared_openings:
+                    mate = live.index(i ^ 1) if (i ^ 1) in live else j
+                    moves[j] = pick[min(j, mate)]
+                else:
+                    moves[j] = pick[j]
         else:
             agents = (agent_a,) if agent_b is agent_a else (agent_a, agent_b)
             for agent in agents:

@@ -131,6 +131,18 @@ class TestArena:
         assert op[0] == op[1] and op[2] == op[3]
         assert op[0] != op[2]
 
+    def test_per_game_openings_break_pair_duplication(self):
+        # corpus-generation mode (shared_openings=False): every game gets
+        # its own opening, so a deterministic self-pair no longer produces
+        # the same game twice (tools/make_selfplay_corpus.py uses this —
+        # pair-shared openings would leak duplicate games across
+        # train/validation splits)
+        games, _, _ = arena.play_match(
+            arena.OnePlyAgent(), arena.OnePlyAgent(), n_games=4,
+            max_moves=30, seed=5, opening_plies=6, shared_openings=False)
+        op = [[(m.x, m.y) for m in g.moves[:6]] for g in games]
+        assert len({tuple(o) for o in op}) == 4
+
     def test_scored_sgf_roundtrip(self):
         games, scores, _ = arena.play_match(
             arena.RandomAgent(), arena.RandomAgent(),

@@ -42,6 +42,14 @@ def main(argv=None) -> None:
     ap.add_argument("--temperature", type=float, default=0.25,
                     help="sampling temperature for checkpoint:/model: agents "
                          "(diversifies otherwise-deterministic games)")
+    ap.add_argument("--opening-plies", type=int, default=0,
+                    help="start each GAME from this many independent "
+                         "uniformly-random plies (per-game, not the "
+                         "pair-shared match openings). Search agents "
+                         "(search:/search2:/value:) are deterministic and "
+                         "ignore temperature, so without openings a "
+                         "self-pair chunk collapses to one game duplicated "
+                         "chunk-size times")
     ap.add_argument("--rank", type=int, default=8,
                     help="dan-rank tag for policy agents (baselines keep "
                          "their make_corpus tags: oneply=8, heuristic=4)")
@@ -56,9 +64,13 @@ def main(argv=None) -> None:
     pairs = [tuple(p.split(",")) for p in args.pairs]
     assert all(len(p) == 2 for p in pairs), "each --pairs entry is 'specA,specB'"
     agents: dict[str, arena.Agent] = {}
+    deterministic_prefixes = ("search:", "search2:", "value:")
     for spec in {s for p in pairs for s in p}:
-        temp = 0.0 if spec in baseline_rank or spec.startswith("search:") \
-            else args.temperature
+        # search-family agents are deterministic re-rankers; _make_agent
+        # would silently drop (value:/search2:) or reject (search:) a
+        # temperature, so pin 0.0 explicitly for all of them
+        temp = 0.0 if spec in baseline_rank \
+            or spec.startswith(deterministic_prefixes) else args.temperature
         agents[spec] = arena._make_agent(spec, args.seed, temp, args.rank)
 
     def rank_of(spec: str) -> int:
@@ -75,7 +87,10 @@ def main(argv=None) -> None:
         n = min(args.chunk, args.games - totals["games"])
         games, scores, stats = arena.play_match(
             agents[spec_a], agents[spec_b], n_games=n,
-            max_moves=args.max_moves, seed=args.seed + round_idx)
+            max_moves=args.max_moves, seed=args.seed + round_idx,
+            # per-game openings: a corpus wants trajectory diversity, not
+            # the pair-fairness of a win-rate match (play_match docstring)
+            opening_plies=args.opening_plies, shared_openings=False)
         totals["truncated"] += stats["truncated"]
         for i, (g, s) in enumerate(zip(games, scores)):
             gid = totals["games"]

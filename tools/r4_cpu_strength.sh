@@ -77,7 +77,7 @@ PY
 # selfplay_corpus <out> <pair...> — 2,560 games through the shard pipeline
 selfplay_corpus() {
   local out=$1; shift
-  [ -f "$out/processed/train/planes.bin" ] && { echo "$out already built"; return 0; }
+  [ -f "$out/processed/test/games.json" ] && { echo "$out already built"; return 0; }  # test/games.json is the LAST artifact transcription writes (train,validation,test in order; finalize writes games.json last), so its presence proves the whole build completed — guarding on the first artifact would skip an interrupted build forever
   stage "selfplay corpus $out"
   nice -n $N timeout 14400 python -u tools/make_selfplay_corpus.py \
     --out "$out" --pairs "$@" --games 2560 --chunk 512 --rank 8 --seed 23 \

@@ -1,0 +1,147 @@
+#!/bin/bash
+# Value-guided self-improvement loop: reproduce the round-4 rungs on a
+# fresh machine, then run the compounding iteration RESULTS.md sketched
+# for round 5.
+#
+# Round 4 measured (ad-hoc, first session): a 3L/64 value net (value1)
+# over the main corpus's decided games; the value-guided search agent
+# on ft2k (67.6% vs oneply); one winner-distillation round from the
+# value expert's games (cpu-ft-iterv, 69.4% wrapped); and the composed
+# champion value:iterv:value1 at 73.1%. The runs/ tree those artifacts
+# lived in is machine-local, so this script first rebuilds them under
+# done-markers, then extends the loop one full turn:
+#
+#   iterv2 corpus:  1,280 fresh games by the CHAMPION value:iterv:value1
+#   value2:         the value net RETRAINED on the loop's own expert
+#                   games (iterv2+iterv union — the trainable-expert
+#                   half of the compounding thesis)
+#   cpu-ft-iterv2:  second winner distillation (from iterv, on iterv2)
+#   factorial matches that separate the levers, 1,000 games each:
+#     value:iterv:value2     new value net, old prior
+#     value:iterv2:value1    new prior, old value net
+#     value:iterv2:value2    the full compounding rung (beats 73.1%?)
+#
+# Protocol pins (RESULTS.md "1,000-game precision"): vs oneply,
+# --opening-plies 8 --seed 29 --rank 8. Everything CPU
+# (JAX_PLATFORMS=cpu) and nice -n 10: never dials the relay, yields the
+# single host core to live chip work. Stages idempotent via
+# find_ckpt / done-markers like the other queues.
+set -u
+cd "$(dirname "$0")/.."
+. tools/r3_lib.sh
+mkdir -p runs/r5logs
+export JAX_PLATFORMS=cpu
+CORPUS=data/corpus/processed
+N=${NICE:-10}
+
+stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
+
+vmatch() {  # vmatch <specA> <tag> [games] — vs oneply under the pins
+  local a=$1 tag=$2 games=${3:-1000}
+  local mark=runs/r5logs/done_arena_$tag
+  [ -f "$mark" ] && { echo "arena $tag already done"; return 0; }
+  stage "arena $tag"
+  nice -n $N timeout 43200 python -u -m deepgo_tpu.arena \
+    --a "$a" --b oneply --games "$games" --rank 8 --seed 29 \
+    --opening-plies 8 >> runs/r5logs/arena.log 2>&1
+  local rc=$?
+  [ $rc -eq 0 ] && touch "$mark"
+  echo "arena $tag rc=$rc"
+  tail -1 runs/r5logs/arena.log
+}
+
+winner_sidecars() {  # winner_sidecars <corpus_root>
+  for s in train validation; do
+    [ -f "$1/processed/$s/winner.npy" ] || nice -n $N timeout 3600 \
+      python tools/winner_index.py --processed "$1/processed/$s" \
+      --sgf "$1/sgf/$s" >> runs/r5logs/winner.log 2>&1
+  done
+}
+
+value_train() {  # value_train <out_dir> <data_roots_csv>
+  [ -f "$1/value_checkpoint.npz" ] && { echo "$1 already trained"; return 0; }
+  stage "value train $1"
+  nice -n $N timeout 28800 python -u tools/train_value.py \
+    --data-root "$2" --iters 2000 --out "$1" \
+    >> "runs/r5logs/value_train_$(basename "$1").log" 2>&1
+  echo "value train $1 rc=$?"
+  grep "value validation" "runs/r5logs/value_train_$(basename "$1").log" | tail -1
+}
+
+selfplay_corpus() {  # selfplay_corpus <out> <seed> <pairA> <pairB>
+  local out=$1 seed=$2; shift 2
+  [ -f "$out/processed/test/games.json" ] && { echo "$out already built"; return 0; }  # test/games.json is the LAST artifact transcription writes (train,validation,test in order; finalize writes games.json last), so its presence proves the whole build completed — guarding on the first artifact would skip an interrupted build forever
+  stage "selfplay corpus $out"
+  nice -n $N timeout 43200 python -u tools/make_selfplay_corpus.py \
+    --out "$out" --pairs "$@" --games 1280 --chunk 256 --rank 8 --opening-plies 8 \
+    --seed "$seed" >> runs/r5logs/selfplay.log 2>&1
+  echo "selfplay corpus $out rc=$?"
+}
+
+distill() {  # distill <name> <from_ckpt> <corpus_root> — 500 winner steps
+  local name=$1 from=$2 corpus=$3 iters=500
+  read -r CK STEP <<< "$(find_ckpt "$name")"
+  local from_step
+  from_step=$(CKPT="$from" python - <<'PY'
+import os
+from deepgo_tpu.experiments.checkpoint import load_meta
+print(load_meta(os.environ["CKPT"])["step"])
+PY
+)
+  if [ -n "${CK:-}" ] && [ "${STEP:-0}" -ge $((from_step + iters)) ]; then
+    echo "$name already at step $STEP"; return 0
+  fi
+  stage "distill $name"
+  winner_sidecars "$corpus"
+  nice -n $N timeout 14400 python -u -m deepgo_tpu.experiments.repeated \
+    --checkpoint "$from" --iters $iters --set \
+    name="$name" data_root="$corpus/processed" scheme=winner rate=0.005 \
+    momentum=0.9 steps_per_call=1 print_interval=50 \
+    validation_interval=$iters validation_size=2048 \
+    >> runs/r5logs/distill.log 2>&1
+  echo "distill $name rc=$?"
+}
+
+# --- prereqs: cpu-base / cpu-ft2k + main-corpus winner sidecars ---
+bash tools/r3_cpu_strength.sh || { echo "prereq pipeline failed"; exit 1; }
+read -r FT FT_STEP <<< "$(find_ckpt cpu-ft2k)"
+[ -n "${FT:-}" ] || { echo "no cpu-ft2k checkpoint"; exit 1; }
+echo "cpu-ft2k: $FT (step $FT_STEP)"
+
+# --- round-4 rungs rebuilt (value1, the value wrapper, iterv) ---
+V1=runs/value1/value_checkpoint.npz
+value_train runs/value1 "$CORPUS"
+[ -f "$V1" ] || { echo "no value1 checkpoint"; exit 1; }
+
+vmatch "value:$FT:$V1" ft2k_value1
+
+selfplay_corpus data/iterv 23 \
+  "value:$FT:$V1,oneply" "value:$FT:$V1,value:$FT:$V1"
+distill cpu-ft-iterv "$FT" data/iterv
+read -r IV IV_STEP <<< "$(find_ckpt cpu-ft-iterv)"
+[ -n "${IV:-}" ] || { echo "no cpu-ft-iterv checkpoint"; exit 1; }
+echo "cpu-ft-iterv: $IV (step $IV_STEP)"
+
+vmatch "search:$IV" iterv_veto
+vmatch "value:$IV:$V1" iterv_value1
+
+# --- the round-5 compounding turn ---
+selfplay_corpus data/iterv2 31 \
+  "value:$IV:$V1,oneply" "value:$IV:$V1,value:$IV:$V1"
+winner_sidecars data/iterv2
+
+winner_sidecars data/iterv  # distill may have early-returned on resume without rebuilding these
+V2=runs/value2/value_checkpoint.npz
+value_train runs/value2 "data/iterv2/processed,data/iterv/processed"
+[ -f "$V2" ] || { echo "no value2 checkpoint"; exit 1; }
+
+distill cpu-ft-iterv2 "$IV" data/iterv2
+read -r IV2 IV2_STEP <<< "$(find_ckpt cpu-ft-iterv2)"
+[ -n "${IV2:-}" ] || { echo "no cpu-ft-iterv2 checkpoint"; exit 1; }
+echo "cpu-ft-iterv2: $IV2 (step $IV2_STEP)"
+
+vmatch "value:$IV:$V2" iterv_value2
+vmatch "value:$IV2:$V1" iterv2_value1
+vmatch "value:$IV2:$V2" iterv2_value2
+
+echo "=== r5 value loop done [$(date -u +%H:%M:%S)] ==="

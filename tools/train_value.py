@@ -12,6 +12,11 @@ why the framework grows a value head at all).
 Usage:
   JAX_PLATFORMS=cpu python tools/train_value.py \
       --data-root data/corpus/processed --iters 2000 --out runs/value
+
+--data-root takes a comma-separated list of processed roots; batches are
+sampled across them proportionally to decided-position counts (the
+round-5 loop retrains the value net on the union of its own expert-game
+corpora — tools/r5_value_loop.sh).
 """
 
 from __future__ import annotations
@@ -100,16 +105,46 @@ def main(argv=None) -> None:
 
     cfg = value_cnn.ValueConfig(num_layers=args.num_layers,
                                 channels=args.channels)
-    train = GoDataset(args.data_root, "train")
-    val = GoDataset(args.data_root, "validation")
-    tr_idx = decided_indices(train)
-    va_idx = decided_indices(val)
+    # --data-root accepts a comma-separated list so a value net can be
+    # retrained on the union of the loop's expert-game corpora (the
+    # round-5 compounding recipe) — a single root keeps the exact
+    # round-4 sampling stream
+    roots = [r for r in args.data_root.split(",") if r]
+    trains = [GoDataset(r, "train") for r in roots]
+    vals = [GoDataset(r, "validation") for r in roots]
+    tr_sets = [(d, decided_indices(d)) for d in trains]
     rng = np.random.default_rng(args.seed)
-    va_batch = gather(val, rng.choice(va_idx, size=min(args.val_size,
-                                                       len(va_idx)),
-                                      replace=False))
-    print(f"train positions (decided games): {len(tr_idx):,} of "
-          f"{len(train):,}; val probe {len(va_batch[0]):,}", flush=True)
+    sizes = np.array([len(ix) for _, ix in tr_sets], dtype=np.float64)
+    weights = sizes / sizes.sum()
+    # validation probe drawn from each root proportionally to its TRAIN
+    # decided-position weight — the probe mirrors the sampling mixture
+    # the multinomial batches use, not each root's own validation size
+    va_parts = []
+    for w, d in zip(weights, vals):
+        ix = decided_indices(d)
+        want = max(1, int(round(args.val_size * w)))
+        take = min(want, len(ix))
+        if take < want:
+            print(f"warning: {d.dir} has only {len(ix)} decided validation "
+                  f"positions (wanted {want}); probe under-represents this "
+                  "root relative to the training mixture", flush=True)
+        va_parts.append(gather(d, rng.choice(ix, size=take, replace=False)))
+    va_batch = tuple(np.concatenate([p[j] for p in va_parts])
+                     for j in range(4))
+    print(f"train positions (decided games): "
+          f"{int(sizes.sum()):,} of {sum(len(d) for d in trains):,} "
+          f"across {len(roots)} root(s); "
+          f"val probe {len(va_batch[0]):,}", flush=True)
+
+    def sample_batch(n: int):
+        if len(tr_sets) == 1:
+            ds, ix = tr_sets[0]
+            return gather(ds, rng.choice(ix, size=n))
+        counts = rng.multinomial(n, weights)
+        parts = [gather(ds, rng.choice(ix, size=c))
+                 for c, (ds, ix) in zip(counts, tr_sets) if c]
+        return tuple(np.concatenate([p[j] for p in parts])
+                     for j in range(4))
 
     optimizer = sgd(args.rate, 0.0, args.momentum)
     params = value_cnn.init(jax.random.key(args.seed), cfg)
@@ -121,8 +156,7 @@ def main(argv=None) -> None:
     ewma = None
     t0 = time.time()
     for i in range(1, args.iters + 1):
-        idx = rng.choice(tr_idx, size=args.batch_size)
-        packed, player, rank, z = gather(train, idx)
+        packed, player, rank, z = sample_batch(args.batch_size)
         params, opt_state, loss = step(params, opt_state, packed, player,
                                        rank, z)
         if i % args.print_interval == 0:
