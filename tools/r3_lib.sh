@@ -1,10 +1,11 @@
-# Shared helpers for the round-3 chip-work queues. Source from a script
-# whose cwd is the repo root:   . tools/r3_lib.sh
+# Shared helpers for the chip-work and CPU-strength queues. Source from
+# a script whose cwd is the repo root:   . tools/r3_lib.sh
 #
-# tools/r3_tpu_queue.sh still carries inline copies of these because it
-# was already executing when this file was factored out (editing a
-# running bash script corrupts its lazy parse); fold it over to this lib
-# the next time it is touched while idle.
+# All queue scripts (r3_tpu_queue, r3/r4_cpu_strength, r5_value_loop)
+# source this lib; per-script variation comes in as parameters (log
+# paths, game counts, iters), never as edited copies — the copies were
+# how the stalled-grandchild kill bug and the first-artifact idempotence
+# guard each had to be fixed twice.
 
 # Real-compute canary: the relay can be in a state where claim probes
 # succeed but computation wedges, so gate every stage on an actual jitted
@@ -65,4 +66,60 @@ for rid in os.listdir("runs"):
             best = (p, m["step"])
 print(f"{best[0]} {best[1]}" if best else "")
 PY
+}
+
+stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
+
+# ensure_winner_sidecars <corpus_root> <log>: build the winner.npy
+# outcome sidecars for the train+validation shards if absent (the
+# transcription finalize deletes stale ones, so "absent" is the only
+# state that needs work)
+ensure_winner_sidecars() {
+  local root=$1 log=$2 s
+  for s in train validation; do
+    [ -f "$root/processed/$s/winner.npy" ] || nice -n "${NICE:-10}" \
+      timeout 3600 python tools/winner_index.py \
+      --processed "$root/processed/$s" --sgf "$root/sgf/$s" >> "$log" 2>&1
+  done
+}
+
+# build_selfplay_corpus <out> <log> <games> <chunk> <opening_plies> <seed> <timeout_s> <pairA> [pairB...]
+# Idempotence keys on the LAST transcription artifact (splits run
+# train,validation,test in order and finalize writes games.json last),
+# so an interrupted build reruns instead of being skipped forever.
+build_selfplay_corpus() {
+  local out=$1 log=$2 games=$3 chunk=$4 op=$5 seed=$6 tmo=$7; shift 7
+  [ -f "$out/processed/test/games.json" ] && { echo "$out already built"; return 0; }
+  stage "selfplay corpus $out"
+  nice -n "${NICE:-10}" timeout "$tmo" python -u tools/make_selfplay_corpus.py \
+    --out "$out" --pairs "$@" --games "$games" --chunk "$chunk" --rank 8 \
+    --opening-plies "$op" --seed "$seed" >> "$log" 2>&1
+  echo "selfplay corpus $out rc=$?"
+}
+
+# distill_winner <name> <from_ckpt> <corpus_root> <iters> <log>
+# Winner-conditioned fine-tune (the expert-iteration recipe: rate .005,
+# momentum .9, validate once at the end); skips when a checkpoint named
+# <name> already reached from_step+iters.
+distill_winner() {
+  local name=$1 from=$2 corpus=$3 iters=$4 log=$5
+  local ck step from_step
+  read -r ck step <<< "$(find_ckpt "$name")"
+  from_step=$(CKPT="$from" python - <<'PY'
+import os
+from deepgo_tpu.experiments.checkpoint import load_meta
+print(load_meta(os.environ["CKPT"])["step"])
+PY
+)
+  if [ -n "${ck:-}" ] && [ "${step:-0}" -ge $((from_step + iters)) ]; then
+    echo "$name already at step $step"; return 0
+  fi
+  stage "distill $name"
+  ensure_winner_sidecars "$corpus" "$log"
+  nice -n "${NICE:-10}" timeout 14400 python -u -m deepgo_tpu.experiments.repeated \
+    --checkpoint "$from" --iters "$iters" --set \
+    name="$name" data_root="$corpus/processed" scheme=winner rate=0.005 \
+    momentum=0.9 steps_per_call=1 print_interval=50 \
+    validation_interval="$iters" validation_size=2048 >> "$log" 2>&1
+  echo "distill $name rc=$?"
 }

@@ -10,45 +10,7 @@ cd "$(dirname "$0")/.."
 mkdir -p runs/r3logs
 CORPUS=data/corpus/processed
 
-stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
-
-# Real-compute canary: the relay can be in a state where claim probes
-# succeed but computation wedges, so gate every stage on an actual jitted
-# matmul round-trip. Returns nonzero (and the caller skips the stage) if
-# the chip is not answering.
-canary() {
-  timeout 120 python -c "
-import jax, jax.numpy as jnp
-x = jnp.ones((128, 128))
-print('canary', float(jax.jit(lambda a: (a @ a).sum())(x)))" \
-    >/dev/null 2>&1
-}
-
-# supervise <log> <stall_s> <cmd...>: run cmd, kill it if <log> stops
-# growing for <stall_s> seconds (a wedge mid-stage otherwise burns the
-# stage's whole timeout). rc 97 = killed for stalling.
-supervise() {
-  local log=$1 stall=$2; shift 2
-  "$@" &
-  local pid=$! last=-1 same=0
-  while kill -0 $pid 2>/dev/null; do
-    sleep 30
-    local size=$(stat -c %s "$log" 2>/dev/null || echo 0)
-    if [ "$size" = "$last" ]; then
-      same=$((same + 30))
-      if [ $same -ge $stall ]; then
-        echo "supervise: killing stalled pid $pid (log $log frozen ${same}s)"
-        kill $pid 2>/dev/null; sleep 2; kill -9 $pid 2>/dev/null
-        # kill the whole process group's children (timeout wraps python)
-        pkill -9 -P $pid 2>/dev/null
-        return 97
-      fi
-    else
-      same=0; last=$size
-    fi
-  done
-  wait $pid
-}
+. tools/r3_lib.sh  # canary / supervise (setsid group-kill) / find_ckpt
 
 run_curve() {
   stage curve
@@ -70,6 +32,9 @@ CONVERGE_ITERS=16000
 
 run_converge() {
   stage converge
+  # batch 512 / rate 0.01 / no momentum = the PROVEN flagship-curve recipe
+  # (docs/accuracy_curve.jsonl); the earlier 1024/0.02/0.9 setting NaNs
+  # 12L/128 from the first print window
   read -r CKPT STEP <<< "$(find_ckpt converge-12L128)"
   if [ -n "${CKPT:-}" ] && [ "${STEP:-0}" -ge $CONVERGE_ITERS ]; then
     echo "converge already at step $STEP; skipping"; return 0
@@ -86,35 +51,14 @@ run_converge() {
     supervise runs/r3logs/converge.log 600 \
       timeout 10800 python -u -m deepgo_tpu.cli train --iters $CONVERGE_ITERS --set \
       name=converge-12L128 data_root=$CORPUS scheme=uniform \
-      num_layers=12 channels=128 batch_size=1024 steps_per_call=20 \
-      rate=0.02 momentum=0.9 rate_decay=1e-7 \
+      num_layers=12 channels=128 batch_size=512 steps_per_call=20 \
+      rate=0.01 momentum=0.0 rate_decay=1e-7 \
       validation_interval=2000 validation_size=4096 print_interval=100 \
       >> runs/r3logs/converge.log 2>&1
   fi
   echo "converge rc=$?"
 }
 
-# newest checkpoint whose config name is $1 -> "path step" (empty if none)
-find_ckpt() {
-  NAME=$1 python - <<'PY'
-import os
-from deepgo_tpu.experiments.checkpoint import load_meta
-want = os.environ["NAME"]
-best = None
-for rid in os.listdir("runs"):
-    p = os.path.join("runs", rid, "checkpoint.npz")
-    if not os.path.exists(p):
-        continue
-    try:
-        m = load_meta(p)
-    except Exception:
-        continue
-    if m.get("config", {}).get("name") == want:
-        if best is None or m["step"] > best[1]:
-            best = (p, m["step"])
-print(f"{best[0]} {best[1]}" if best else "")
-PY
-}
 
 # 200-game matches of checkpoint $1 vs oneply and heuristic, tag $2
 match_vs_baselines() {

@@ -28,8 +28,6 @@ export JAX_PLATFORMS=cpu
 CORPUS=data/corpus/processed
 N=${NICE:-10}
 
-stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
-
 # cpu_match <spec_a> <spec_b> <tag> [games]
 cpu_match() {
   local a=$1 b=$2 tag=$3 games=${4:-200}
@@ -45,45 +43,6 @@ cpu_match() {
   tail -1 runs/r4logs/cpu_arena.log
 }
 
-# distill <name> <from_ckpt> <corpus> [iters] -> echoes nothing; find_ckpt after
-distill() {
-  local name=$1 from=$2 corpus=$3 iters=${4:-500}
-  read -r CK STEP <<< "$(find_ckpt "$name")"
-  local from_step
-  from_step=$(CKPT="$from" python - <<'PY'
-import os
-from deepgo_tpu.experiments.checkpoint import load_meta
-print(load_meta(os.environ["CKPT"])["step"])
-PY
-)
-  if [ -n "${CK:-}" ] && [ "${STEP:-0}" -ge $((from_step + iters)) ]; then
-    echo "$name already at step $STEP"; return 0
-  fi
-  stage "distill $name"
-  for s in train validation; do
-    [ -f "$corpus/processed/$s/winner.npy" ] || nice -n $N timeout 3600 \
-      python tools/winner_index.py --processed "$corpus/processed/$s" \
-      --sgf "$corpus/sgf/$s" >> runs/r4logs/distill.log 2>&1
-  done
-  nice -n $N timeout 14400 python -u -m deepgo_tpu.experiments.repeated \
-    --checkpoint "$from" --iters "$iters" --set \
-    name="$name" data_root="$corpus/processed" scheme=winner rate=0.005 \
-    momentum=0.9 steps_per_call=1 print_interval=50 \
-    validation_interval="$iters" validation_size=2048 \
-    >> runs/r4logs/distill.log 2>&1
-  echo "distill $name rc=$?"
-}
-
-# selfplay_corpus <out> <pair...> — 2,560 games through the shard pipeline
-selfplay_corpus() {
-  local out=$1; shift
-  [ -f "$out/processed/test/games.json" ] && { echo "$out already built"; return 0; }  # test/games.json is the LAST artifact transcription writes (train,validation,test in order; finalize writes games.json last), so its presence proves the whole build completed — guarding on the first artifact would skip an interrupted build forever
-  stage "selfplay corpus $out"
-  nice -n $N timeout 14400 python -u tools/make_selfplay_corpus.py \
-    --out "$out" --pairs "$@" --games 2560 --chunk 512 --rank 8 --seed 23 \
-    >> runs/r4logs/selfplay.log 2>&1
-  echo "selfplay corpus rc=$?"
-}
 
 # --- prereq: round-3 CPU checkpoints ---
 bash tools/r3_cpu_strength.sh || { echo "prereq pipeline failed"; exit 1; }
@@ -98,8 +57,9 @@ cpu_match "search2:$FT" oneply twoply_ft2k_oneply
 cpu_match "search2:$FT" heuristic twoply_ft2k_heuristic
 
 # --- verdict item 4b: distillation round from the 2-ply expert ---
-selfplay_corpus data/iter2p "search2:$FT,oneply" "search2:$FT,search2:$FT"
-distill cpu-ft-iter2p "$FT" data/iter2p 500
+build_selfplay_corpus data/iter2p runs/r4logs/selfplay.log 2560 512 0 23 14400 \
+  "search2:$FT,oneply" "search2:$FT,search2:$FT"
+distill_winner cpu-ft-iter2p "$FT" data/iter2p 500 runs/r4logs/distill.log
 read -r I2P I2P_STEP <<< "$(find_ckpt cpu-ft-iter2p)"
 [ -n "${I2P:-}" ] || { echo "no iter2p checkpoint"; exit 1; }
 echo "cpu-ft-iter2p: $I2P (step $I2P_STEP)"
@@ -108,8 +68,9 @@ cpu_match "search:$I2P" oneply iter2p_veto_oneply
 cpu_match "search2:$I2P" oneply iter2p_twoply_oneply
 
 # --- second loop round: fresh 2-ply games by iter2p, distilled back ---
-selfplay_corpus data/iter3p "search2:$I2P,oneply" "search2:$I2P,search2:$I2P"
-distill cpu-ft-iter3p "$I2P" data/iter3p 500
+build_selfplay_corpus data/iter3p runs/r4logs/selfplay.log 2560 512 0 23 14400 \
+  "search2:$I2P,oneply" "search2:$I2P,search2:$I2P"
+distill_winner cpu-ft-iter3p "$I2P" data/iter3p 500 runs/r4logs/distill.log
 read -r I3P I3P_STEP <<< "$(find_ckpt cpu-ft-iter3p)"
 if [ -n "${I3P:-}" ]; then
   cpu_match "checkpoint:$I3P" oneply iter3p_raw_oneply

@@ -34,8 +34,6 @@ export JAX_PLATFORMS=cpu
 CORPUS=data/corpus/processed
 N=${NICE:-10}
 
-stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
-
 vmatch() {  # vmatch <specA> <tag> [games] — vs oneply under the pins
   local a=$1 tag=$2 games=${3:-1000}
   local mark=runs/r5logs/done_arena_$tag
@@ -50,14 +48,6 @@ vmatch() {  # vmatch <specA> <tag> [games] — vs oneply under the pins
   tail -1 runs/r5logs/arena.log
 }
 
-winner_sidecars() {  # winner_sidecars <corpus_root>
-  for s in train validation; do
-    [ -f "$1/processed/$s/winner.npy" ] || nice -n $N timeout 3600 \
-      python tools/winner_index.py --processed "$1/processed/$s" \
-      --sgf "$1/sgf/$s" >> runs/r5logs/winner.log 2>&1
-  done
-}
-
 value_train() {  # value_train <out_dir> <data_roots_csv>
   [ -f "$1/value_checkpoint.npz" ] && { echo "$1 already trained"; return 0; }
   stage "value train $1"
@@ -66,40 +56,6 @@ value_train() {  # value_train <out_dir> <data_roots_csv>
     >> "runs/r5logs/value_train_$(basename "$1").log" 2>&1
   echo "value train $1 rc=$?"
   grep "value validation" "runs/r5logs/value_train_$(basename "$1").log" | tail -1
-}
-
-selfplay_corpus() {  # selfplay_corpus <out> <seed> <pairA> <pairB>
-  local out=$1 seed=$2; shift 2
-  [ -f "$out/processed/test/games.json" ] && { echo "$out already built"; return 0; }  # test/games.json is the LAST artifact transcription writes (train,validation,test in order; finalize writes games.json last), so its presence proves the whole build completed — guarding on the first artifact would skip an interrupted build forever
-  stage "selfplay corpus $out"
-  nice -n $N timeout 43200 python -u tools/make_selfplay_corpus.py \
-    --out "$out" --pairs "$@" --games 1280 --chunk 256 --rank 8 --opening-plies 8 \
-    --seed "$seed" >> runs/r5logs/selfplay.log 2>&1
-  echo "selfplay corpus $out rc=$?"
-}
-
-distill() {  # distill <name> <from_ckpt> <corpus_root> — 500 winner steps
-  local name=$1 from=$2 corpus=$3 iters=500
-  read -r CK STEP <<< "$(find_ckpt "$name")"
-  local from_step
-  from_step=$(CKPT="$from" python - <<'PY'
-import os
-from deepgo_tpu.experiments.checkpoint import load_meta
-print(load_meta(os.environ["CKPT"])["step"])
-PY
-)
-  if [ -n "${CK:-}" ] && [ "${STEP:-0}" -ge $((from_step + iters)) ]; then
-    echo "$name already at step $STEP"; return 0
-  fi
-  stage "distill $name"
-  winner_sidecars "$corpus"
-  nice -n $N timeout 14400 python -u -m deepgo_tpu.experiments.repeated \
-    --checkpoint "$from" --iters $iters --set \
-    name="$name" data_root="$corpus/processed" scheme=winner rate=0.005 \
-    momentum=0.9 steps_per_call=1 print_interval=50 \
-    validation_interval=$iters validation_size=2048 \
-    >> runs/r5logs/distill.log 2>&1
-  echo "distill $name rc=$?"
 }
 
 # --- prereqs: cpu-base / cpu-ft2k + main-corpus winner sidecars ---
@@ -115,9 +71,9 @@ value_train runs/value1 "$CORPUS"
 
 vmatch "value:$FT:$V1" ft2k_value1
 
-selfplay_corpus data/iterv 23 \
+build_selfplay_corpus data/iterv runs/r5logs/selfplay.log 1280 256 8 23 43200 \
   "value:$FT:$V1,oneply" "value:$FT:$V1,value:$FT:$V1"
-distill cpu-ft-iterv "$FT" data/iterv
+distill_winner cpu-ft-iterv "$FT" data/iterv 500 runs/r5logs/distill.log
 read -r IV IV_STEP <<< "$(find_ckpt cpu-ft-iterv)"
 [ -n "${IV:-}" ] || { echo "no cpu-ft-iterv checkpoint"; exit 1; }
 echo "cpu-ft-iterv: $IV (step $IV_STEP)"
@@ -126,16 +82,16 @@ vmatch "search:$IV" iterv_veto
 vmatch "value:$IV:$V1" iterv_value1
 
 # --- the round-5 compounding turn ---
-selfplay_corpus data/iterv2 31 \
+build_selfplay_corpus data/iterv2 runs/r5logs/selfplay.log 1280 256 8 31 43200 \
   "value:$IV:$V1,oneply" "value:$IV:$V1,value:$IV:$V1"
-winner_sidecars data/iterv2
+ensure_winner_sidecars data/iterv2 runs/r5logs/winner.log
 
-winner_sidecars data/iterv  # distill may have early-returned on resume without rebuilding these
+ensure_winner_sidecars data/iterv runs/r5logs/winner.log  # distill may have early-returned on resume without rebuilding these
 V2=runs/value2/value_checkpoint.npz
 value_train runs/value2 "data/iterv2/processed,data/iterv/processed"
 [ -f "$V2" ] || { echo "no value2 checkpoint"; exit 1; }
 
-distill cpu-ft-iterv2 "$IV" data/iterv2
+distill_winner cpu-ft-iterv2 "$IV" data/iterv2 500 runs/r5logs/distill.log
 read -r IV2 IV2_STEP <<< "$(find_ckpt cpu-ft-iterv2)"
 [ -n "${IV2:-}" ] || { echo "no cpu-ft-iterv2 checkpoint"; exit 1; }
 echo "cpu-ft-iterv2: $IV2 (step $IV2_STEP)"
