@@ -1,0 +1,193 @@
+"""Match harness: batched games between two agents, scored, win rates out.
+
+N games advance in lockstep, colors alternate across games (game i gives
+black to agent ``i % 2``), each ply batches all boards where a given
+agent is to move into one TPU forward (for policy agents) or one
+vectorized host step (for baselines), and finished games are
+Tromp-Taylor scored (``go.scoring.area_score``) to produce W/L and
+margins. The players live in deepgo_tpu.agents; the ``python -m
+deepgo_tpu.arena`` CLI entry is preserved by the arena shim.
+
+Usage:
+  python -m deepgo_tpu.arena --a checkpoint:runs/<id>/checkpoint.npz \
+      --b random --games 64 [--komi 7.5] [--sgf-out arena_games/]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+from .agents import Agent, _make_agent
+from .go import BLACK
+from .go.scoring import area_score
+from .selfplay import (GameState, legal_mask, step_games, summarize_states,
+                       to_sgf)
+
+def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
+               komi: float = 7.5, max_moves: int = 450, seed: int = 0,
+               opening_plies: int = 0, shared_openings: bool = True):
+    """Run n_games with alternating colors; returns (games, scores, stats).
+
+    Game i gives black to agent_a when i is even. Every active game advances
+    one ply per iteration, so all active boards share a side-to-move and each
+    agent sees at most one batch per ply.
+
+    ``opening_plies > 0`` starts each game with that many uniformly-random
+    legal moves before the agents take over, with games 2i and 2i+1
+    SHARING an opening (the color-swapped rematch starts from the same
+    position). Two deterministic agents otherwise produce one pair of
+    games replicated n_games/2 times — sub-ulp tie-break noise almost
+    never flips a trained net's argmax — so a 200-game match carries two
+    games' worth of evidence; balanced random openings restore n_games
+    distinct trajectories while keeping the color-paired fairness.
+
+    ``shared_openings=False`` draws an independent opening per GAME
+    instead of per pair. Win-rate evaluation wants the pair-shared
+    default (the color-swapped rematch from the same position is what
+    makes the pairing fair); corpus generation wants maximum trajectory
+    diversity — a deterministic agent playing itself from a pair-shared
+    opening produces the SAME game twice, and the duplicates can
+    straddle train/validation splits downstream.
+    """
+    rng = np.random.default_rng(seed)
+    games = [GameState() for _ in range(n_games)]
+    # black_agent[i] plays BLACK in game i
+    agent_of = [(agent_a, agent_b) if i % 2 == 0 else (agent_b, agent_a)
+                for i in range(n_games)]
+    plies = 0
+    t0 = time.time()
+
+    while True:
+        live = [i for i, g in enumerate(games) if not g.done]
+        if not live:
+            break
+        packed = summarize_states([games[i] for i in live])
+        players = np.array([games[i].player for i in live], dtype=np.int32)
+        legal = legal_mask(packed, players, [games[i] for i in live])
+        plies += len(live)
+
+        moves = np.full(len(live), -1, dtype=np.int64)
+        if len(games[live[0]].moves) < opening_plies:
+            # balanced random opening: draw one legal point per PAIR and
+            # give it to both color assignments (identical positions, so
+            # one draw is legal in both)
+            u = rng.random(legal.shape)
+            pick = np.where(legal, u, -1.0).argmax(axis=1)
+            pick = np.where(legal.any(axis=1), pick, -1)
+            for j, i in enumerate(live):
+                if shared_openings:
+                    mate = live.index(i ^ 1) if (i ^ 1) in live else j
+                    moves[j] = pick[min(j, mate)]
+                else:
+                    moves[j] = pick[j]
+        else:
+            agents = (agent_a,) if agent_b is agent_a else (agent_a, agent_b)
+            for agent in agents:
+                sel = [j for j, i in enumerate(live)
+                       if agent_of[i][games[i].player - 1] is agent]
+                if sel:
+                    moves[sel] = agent.select_moves(
+                        packed[sel], players[sel], legal[sel], rng)
+
+        step_games([games[i] for i in live], moves.tolist(), max_moves)
+
+    scores = [area_score(g.stones, komi=komi) for g in games]
+    dt = time.time() - t0
+
+    a_wins = b_wins = draws = 0
+    a_black_wins = 0
+    margins = []
+    for i, s in enumerate(scores):
+        winner = s.winner
+        black, white = agent_of[i]
+        margins.append(s.margin if black is agent_a else -s.margin)
+        if winner == 0:
+            draws += 1
+        elif (black if winner == BLACK else white) is agent_a:
+            a_wins += 1
+            if winner == BLACK and black is agent_a:
+                a_black_wins += 1
+        else:
+            b_wins += 1
+    name_a = agent_a.name
+    name_b = agent_b.name if agent_b.name != name_a else agent_b.name + "-b"
+    # area-scoring a move-cap-truncated board is an approximation; surface
+    # how much of the result rests on it so win-rate consumers can judge
+    truncated = sum(1 for g in games if g.passes < 2)
+    stats = {
+        "games": n_games,
+        "truncated": truncated,
+        f"{name_a}_wins": a_wins,
+        f"{name_b}_wins": b_wins,
+        "draws": draws,
+        f"{name_a}_win_rate": a_wins / n_games,
+        f"{name_a}_wins_as_black": a_black_wins,
+        "mean_margin_for_a": float(np.mean(margins)),
+        "plies": plies,
+        "seconds": dt,
+        "positions_per_sec": plies / dt,
+    }
+    return games, scores, stats
+
+
+def main(argv=None) -> None:
+    import os
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--a", default="model:small", help="agent A spec")
+    ap.add_argument("--b", default="random", help="agent B spec")
+    ap.add_argument("--games", type=int, default=32)
+    ap.add_argument("--komi", type=float, default=7.5)
+    ap.add_argument("--max-moves", type=int, default=450)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="softmax sampling temperature for checkpoint:/model: "
+                         "policy agents (0 = argmax; >0 diversifies "
+                         "policy-vs-policy games); search: agents stay "
+                         "deterministic regardless")
+    ap.add_argument("--rank", type=int, default=9,
+                    help="dan rank fed to policy agents' rank planes; match "
+                         "the training corpus (e.g. 8 for the synthetic "
+                         "corpus, whose strongest games are tagged 8d)")
+    ap.add_argument("--opening-plies", type=int, default=0,
+                    help="start each game pair from this many shared "
+                         "uniformly-random legal moves — restores distinct "
+                         "trajectories in deterministic-vs-deterministic "
+                         "matches (the color-swapped rematch shares the "
+                         "opening, keeping the pairing fair)")
+    ap.add_argument("--sgf-out", help="directory to write scored games")
+    args = ap.parse_args(argv)
+
+    from .utils import honor_platform_env
+
+    honor_platform_env()
+    agent_a = _make_agent(args.a, args.seed, args.temperature, args.rank)
+    agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank)
+    games, scores, stats = play_match(agent_a, agent_b, n_games=args.games,
+                                      komi=args.komi, max_moves=args.max_moves,
+                                      seed=args.seed,
+                                      opening_plies=args.opening_plies)
+    print({k: round(v, 3) if isinstance(v, float) else v
+           for k, v in stats.items()})
+
+    if args.sgf_out:
+        os.makedirs(args.sgf_out, exist_ok=True)
+        finished = 0
+        for i, (g, s) in enumerate(zip(games, scores)):
+            # RE[] only for games that ended on double pass; a move-cap
+            # truncation is scored for the stats table (standard
+            # approximation) but not stamped into the record
+            done = g.passes >= 2
+            finished += done
+            with open(os.path.join(args.sgf_out, f"match_{i:04d}.sgf"), "w") as f:
+                f.write(to_sgf(g, result=s.result_string() if done else None,
+                               komi=args.komi))
+        print(f"wrote {len(games)} SGFs ({finished} finished/scored, "
+              f"{len(games) - finished} move-cap truncated) to {args.sgf_out}")
+
+
+if __name__ == "__main__":
+    main()

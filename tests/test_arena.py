@@ -442,6 +442,95 @@ class TestValueSearchAgent:
             arena._make_agent("value:only_one.npz", seed=0)
 
 
+class TestValue2PlyAgent:
+    @staticmethod
+    def _agent(**kw):
+        import jax
+
+        from deepgo_tpu.models import policy_cnn, value_cnn
+
+        cfg = policy_cnn.ModelConfig(num_layers=2, channels=8)
+        params = policy_cnn.init(jax.random.key(0), cfg)
+        vcfg = value_cnn.ValueConfig(num_layers=2, channels=8)
+        vparams = value_cnn.init(jax.random.key(1), vcfg)
+        return arena.Value2PlyAgent(params, cfg, vparams, vcfg, **kw)
+
+    def test_huge_margin_keeps_policy_argmax(self):
+        # an unreachable margin disables the veto: the move is exactly the
+        # policy argmax even after the full 2-ply candidate/reply expansion
+        agent = self._agent(margin=1e9)
+        g = arena.GameState()
+        play(g.stones, g.age, 10, 10, BLACK)
+        play(g.stones, g.age, 4, 15, WHITE)
+        g.player = 1
+        packed, players, legal = TestTwoPlyAgent._position(g)
+        masked = arena._no_own_eyes(packed, players, legal)
+        logp = agent._legal_log_probs(packed, players, masked)
+        move = agent.select_moves(packed, players, legal,
+                                  np.random.default_rng(0))[0]
+        assert move == int(logp[0].argmax())
+
+    def test_negative_margin_fires_to_a_candidate(self):
+        # margin -inf-ish means the veto always fires; the chosen move must
+        # be a legal candidate, exercising candidates -> replies -> leaf
+        # values -> min-aggregation -> override end to end
+        agent = self._agent(margin=-1e9, top_k=4, reply_k=3)
+        g = arena.GameState()
+        play(g.stones, g.age, 3, 3, BLACK)
+        play(g.stones, g.age, 15, 15, WHITE)
+        g.player = 1
+        packed, players, legal = TestTwoPlyAgent._position(g)
+        move = agent.select_moves(packed, players, legal,
+                                  np.random.default_rng(0))[0]
+        assert move >= 0 and legal[0, move]
+
+    def test_candidate_score_is_min_over_replies(self, monkeypatch):
+        # the pass reply caps every candidate's score at the after-board
+        # value: force the value net to love after-boards (0.9) and hate
+        # every deeper reply leaf (0.1) — the score each candidate carries
+        # into the veto must be the WORST leaf, 0.1 (a max or mean
+        # aggregation, or a 1-ply agent seeing only the rosy after-board,
+        # would report ~0.9 and reintroduce the horizon blunder this
+        # agent exists to close)
+        from deepgo_tpu import agents as agents_mod
+
+        agent = self._agent(margin=-1e9, top_k=2, reply_k=2)
+        calls = []
+
+        def fake_values(boards, to_move):
+            calls.append(len(boards))
+            return np.full(len(boards), 0.9 if len(calls) == 1 else 0.1)
+
+        monkeypatch.setattr(agent, "_values", fake_values)
+        seen = {}
+        real_veto = agents_mod._veto_select
+
+        def spy_veto(logp, legal, cand, rows, cols, cand_scores, *a, **kw):
+            seen["scores"] = np.asarray(cand_scores)
+            return real_veto(logp, legal, cand, rows, cols, cand_scores,
+                             *a, **kw)
+
+        monkeypatch.setattr(agents_mod, "_veto_select", spy_veto)
+        g = arena.GameState()
+        play(g.stones, g.age, 9, 9, BLACK)
+        play(g.stones, g.age, 10, 10, WHITE)
+        g.player = 1
+        packed, players, legal = TestTwoPlyAgent._position(g)
+        agent.select_moves(packed, players, legal, np.random.default_rng(0))
+        # both value passes ran: once for pass-leaves, once for reply leaves
+        assert len(calls) == 2
+        assert calls[1] > calls[0]  # replies outnumber candidates
+        # on an open board every candidate has replies, so min-aggregation
+        # must pull every score down to the 0.1 leaves
+        assert np.all(seen["scores"] <= 0.1 + 1e-9)
+
+    def test_plays_full_games(self):
+        agent = self._agent(top_k=3, reply_k=2)
+        games, scores, stats = arena.play_match(
+            agent, arena.RandomAgent(), n_games=2, max_moves=30, seed=5)
+        assert stats["games"] == 2
+
+
 class TestTwoPlyAgent:
     @staticmethod
     def _agent(**kw):

@@ -64,11 +64,12 @@ def main(argv=None) -> None:
     pairs = [tuple(p.split(",")) for p in args.pairs]
     assert all(len(p) == 2 for p in pairs), "each --pairs entry is 'specA,specB'"
     agents: dict[str, arena.Agent] = {}
-    deterministic_prefixes = ("search:", "search2:", "value:")
+    deterministic_prefixes = ("search:", "search2:", "value:", "value2:")
     for spec in {s for p in pairs for s in p}:
         # search-family agents are deterministic re-rankers; _make_agent
-        # would silently drop (value:/search2:) or reject (search:) a
-        # temperature, so pin 0.0 explicitly for all of them
+        # silently ignores a temperature for all three specs (it is never
+        # forwarded), so the 0.0 pin here changes nothing — it documents
+        # at the call site that these agents play greedily
         temp = 0.0 if spec in baseline_rank \
             or spec.startswith(deterministic_prefixes) else args.temperature
         agents[spec] = arena._make_agent(spec, args.seed, temp, args.rank)
