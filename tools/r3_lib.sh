@@ -70,6 +70,25 @@ PY
 
 stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
 
+# bench_artifact_ok <file>: true when the file's last line is parseable
+# JSON with no TOP-LEVEL "error" key. A per-setting error nested inside
+# "settings" (e.g. --mode large's remat=false OOMing at big batch) is a
+# valid measured outcome; a stale last-good fallback line carries a
+# top-level "error" and so stays not-ok, keeping --until-done loops
+# chasing a live measurement. One definition so the done-check and the
+# post-run incompleteness check cannot drift across the queue scripts.
+bench_artifact_ok() {
+  [ -s "$1" ] && BENCH_ARTIFACT="$1" python - <<'PY'
+import json, os, sys
+try:
+    with open(os.environ["BENCH_ARTIFACT"]) as f:
+        d = json.loads(f.read().strip().splitlines()[-1])
+except Exception:
+    sys.exit(1)
+sys.exit(1 if "error" in d else 0)
+PY
+}
+
 # ensure_winner_sidecars <corpus_root> <log>: build the winner.npy
 # outcome sidecars for the train+validation shards if absent (the
 # transcription finalize deletes stale ones, so "absent" is the only
@@ -94,7 +113,11 @@ build_selfplay_corpus() {
   nice -n "${NICE:-10}" timeout "$tmo" python -u tools/make_selfplay_corpus.py \
     --out "$out" --pairs "$@" --games "$games" --chunk "$chunk" --rank 8 \
     --opening-plies "$op" --seed "$seed" >> "$log" 2>&1
-  echo "selfplay corpus $out rc=$?"
+  local rc=$?
+  echo "selfplay corpus $out rc=$rc"
+  # propagate failure so callers can gate distill/value stages on a
+  # complete corpus instead of training against a partial build
+  return $rc
 }
 
 # distill_winner <name> <from_ckpt> <corpus_root> <iters> <log>

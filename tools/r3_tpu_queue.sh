@@ -152,16 +152,7 @@ run_selfplay() {
 run_bench() {
   stage bench
   for mode in inference train latency large; do
-    # done = parseable JSON with no TOP-LEVEL error key. A per-setting
-    # error inside "settings" (e.g. --mode large's remat=false OOMing at
-    # big batch) is a valid measured outcome, not a retry trigger.
-    if [ -s runs/r3logs/bench_$mode.json ] && python - <<PY
-import json, sys
-with open("runs/r3logs/bench_$mode.json") as f:
-    d = json.loads(f.read().strip().splitlines()[-1])
-sys.exit(1 if "error" in d else 0)
-PY
-    then
+    if bench_artifact_ok runs/r3logs/bench_$mode.json; then
       echo "bench $mode already done"; continue
     fi
     canary || { echo "canary failed; skipping bench $mode"; return 1; }
@@ -174,15 +165,9 @@ PY
     tail -1 runs/r3logs/bench_$mode.json
     # a stale-fallback line exits 0 but leaves a TOP-LEVEL "error" key in
     # the artifact; surface that to the --until-done grep so the retry
-    # horizon keeps trying for a LIVE measurement. Same test as the
-    # done-check above: a nested per-setting error (large's remat OOM) is
-    # a valid measured outcome, not incompleteness.
-    python - <<PY || echo "bench $mode incomplete (error/stale artifact)"
-import json, sys
-with open("runs/r3logs/bench_$mode.json") as f:
-    d = json.loads(f.read().strip().splitlines()[-1])
-sys.exit(1 if "error" in d else 0)
-PY
+    # horizon keeps trying for a LIVE measurement
+    bench_artifact_ok runs/r3logs/bench_$mode.json \
+      || echo "bench $mode incomplete (error/stale artifact)"
   done
 }
 

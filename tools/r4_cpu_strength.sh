@@ -58,7 +58,8 @@ cpu_match "search2:$FT" heuristic twoply_ft2k_heuristic
 
 # --- verdict item 4b: distillation round from the 2-ply expert ---
 build_selfplay_corpus data/iter2p runs/r4logs/selfplay.log 2560 512 0 23 14400 \
-  "search2:$FT,oneply" "search2:$FT,search2:$FT"
+  "search2:$FT,oneply" "search2:$FT,search2:$FT" \
+  || { echo "iter2p corpus build failed"; exit 1; }
 distill_winner cpu-ft-iter2p "$FT" data/iter2p 500 runs/r4logs/distill.log
 read -r I2P I2P_STEP <<< "$(find_ckpt cpu-ft-iter2p)"
 [ -n "${I2P:-}" ] || { echo "no iter2p checkpoint"; exit 1; }
@@ -69,7 +70,8 @@ cpu_match "search2:$I2P" oneply iter2p_twoply_oneply
 
 # --- second loop round: fresh 2-ply games by iter2p, distilled back ---
 build_selfplay_corpus data/iter3p runs/r4logs/selfplay.log 2560 512 0 23 14400 \
-  "search2:$I2P,oneply" "search2:$I2P,search2:$I2P"
+  "search2:$I2P,oneply" "search2:$I2P,search2:$I2P" \
+  || { echo "iter3p corpus build failed"; exit 1; }
 distill_winner cpu-ft-iter3p "$I2P" data/iter3p 500 runs/r4logs/distill.log
 read -r I3P I3P_STEP <<< "$(find_ckpt cpu-ft-iter3p)"
 if [ -n "${I3P:-}" ]; then

@@ -20,20 +20,18 @@ stage() { echo "=== $1 [$(date -u +%H:%M:%S)] ==="; }
 run_bench() {
   stage bench
   for mode in inference train latency large; do
-    if [ -s runs/r4logs/bench_$mode.json ] && python - <<PY
-import json, sys
-with open("runs/r4logs/bench_$mode.json") as f:
-    d = json.loads(f.read().strip().splitlines()[-1])
-sys.exit(1 if "error" in d else 0)
-PY
-    then
+    if bench_artifact_ok runs/r4logs/bench_$mode.json; then
       echo "bench $mode already done"; continue
     fi
     canary || { echo "canary failed; skipping bench $mode"; return 1; }
-    timeout 1800 python bench.py --mode $mode \
+    # 2400s: worst-case preflight (780s) + 900s watchdog, same envelope
+    # arithmetic as the r3/r5 queues
+    timeout 2400 python bench.py --mode $mode \
       > runs/r4logs/bench_$mode.json 2> runs/r4logs/bench_$mode.err
     echo "bench $mode rc=$?"
     tail -1 runs/r4logs/bench_$mode.json
+    bench_artifact_ok runs/r4logs/bench_$mode.json \
+      || echo "bench $mode incomplete (error/stale artifact)"
   done
 }
 
@@ -120,7 +118,7 @@ if [ "${1:-}" = "--until-done" ]; then
     echo "$out"
     # a stage aborting before its "rc=" echo (set -u, missing script)
     # must count as failure too, hence the exit-status check
-    if [ $rc -eq 0 ] && ! echo "$out" | grep -qE "canary failed|rc=[1-9]"; then
+    if [ $rc -eq 0 ] && ! echo "$out" | grep -qE "canary failed|rc=[1-9]|incomplete"; then
       echo "=== all stages complete ==="
       exit 0
     fi

@@ -72,7 +72,8 @@ value_train runs/value1 "$CORPUS"
 vmatch "value:$FT:$V1" ft2k_value1
 
 build_selfplay_corpus data/iterv runs/r5logs/selfplay.log 1280 256 8 23 43200 \
-  "value:$FT:$V1,oneply" "value:$FT:$V1,value:$FT:$V1"
+  "value:$FT:$V1,oneply" "value:$FT:$V1,value:$FT:$V1" \
+  || { echo "iterv corpus build failed"; exit 1; }
 distill_winner cpu-ft-iterv "$FT" data/iterv 500 runs/r5logs/distill.log
 read -r IV IV_STEP <<< "$(find_ckpt cpu-ft-iterv)"
 [ -n "${IV:-}" ] || { echo "no cpu-ft-iterv checkpoint"; exit 1; }
@@ -83,7 +84,8 @@ vmatch "value:$IV:$V1" iterv_value1
 
 # --- the round-5 compounding turn ---
 build_selfplay_corpus data/iterv2 runs/r5logs/selfplay.log 1280 256 8 31 43200 \
-  "value:$IV:$V1,oneply" "value:$IV:$V1,value:$IV:$V1"
+  "value:$IV:$V1,oneply" "value:$IV:$V1,value:$IV:$V1" \
+  || { echo "iterv2 corpus build failed"; exit 1; }
 ensure_winner_sidecars data/iterv2 runs/r5logs/winner.log
 
 ensure_winner_sidecars data/iterv runs/r5logs/winner.log  # distill may have early-returned on resume without rebuilding these

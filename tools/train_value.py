@@ -122,13 +122,23 @@ def main(argv=None) -> None:
     va_parts = []
     for w, d in zip(weights, vals):
         ix = decided_indices(d)
-        want = max(1, int(round(args.val_size * w)))
+        want = max(1, int(round(args.val_size * w))) if w > 0 else 0
         take = min(want, len(ix))
+        if take == 0:
+            # a zero-weight root, or one with decided train positions but
+            # no decided validation positions, contributes nothing —
+            # skip explicitly rather than lean on rng.choice(empty, 0)
+            if w > 0:
+                print(f"warning: {d.dir} has no decided validation "
+                      "positions; probe omits this root entirely",
+                      flush=True)
+            continue
         if take < want:
             print(f"warning: {d.dir} has only {len(ix)} decided validation "
                   f"positions (wanted {want}); probe under-represents this "
                   "root relative to the training mixture", flush=True)
         va_parts.append(gather(d, rng.choice(ix, size=take, replace=False)))
+    assert va_parts, "no root contributed validation positions"
     va_batch = tuple(np.concatenate([p[j] for p in va_parts])
                      for j in range(4))
     print(f"train positions (decided games): "
