@@ -28,7 +28,7 @@ from .dataset import GoDataset
 
 
 class LoaderClosed(RuntimeError):
-    """get()/_host_batch called on (or blocked in) a closed AsyncLoader."""
+    """get()/_drain called on (or blocked in) a closed AsyncLoader."""
 
 
 def make_host_batch(dataset: GoDataset, rng: np.random.Generator, batch_size: int,
@@ -45,6 +45,38 @@ def make_host_batch(dataset: GoDataset, rng: np.random.Generator, batch_size: in
     if augment:
         # per-sample dihedral symmetry index, applied on device
         batch["sym"] = rng.integers(0, 8, size=batch_size).astype(np.int32)
+    return batch
+
+
+def make_host_superbatch(dataset: GoDataset, rng: np.random.Generator,
+                         batch_size: int, stack: int, scheme: str = "game",
+                         augment: bool = False, wire: str = "packed") -> dict:
+    """One (K, B, ...) superbatch from a single K*B-position gather.
+
+    Distributionally identical to np.stack-ing K ``make_host_batch``
+    results (sampling is i.i.d.), but materially cheaper on the host: one
+    memmap gather and one nibble pass over K*B positions, and the (K, B)
+    shape falls out of a free reshape instead of a full stack copy. The
+    round-4 streamed-feed measurement ran 2x under the chip's resident
+    ceiling with the assembly serialized in the uploader thread
+    (VERDICT item 5); feeding is host-bound on a small host, so the fix
+    is fewer passes over the bytes, not more threads.
+    """
+    n = batch_size * stack
+    packed, player, rank, target = dataset.sample_batch(rng, n, scheme)
+    if wire == "nibble":
+        from ..ops.wire import nibble_pack_np
+
+        packed = nibble_pack_np(packed)
+
+    def fold(a: np.ndarray) -> np.ndarray:
+        return a.reshape(stack, batch_size, *a.shape[1:])
+
+    batch = {"packed": fold(packed), "player": fold(player),
+             "rank": fold(rank), "target": fold(target)}
+    if augment:
+        batch["sym"] = rng.integers(
+            0, 8, size=(stack, batch_size)).astype(np.int32)
     return batch
 
 
@@ -111,19 +143,23 @@ class AsyncLoader:
         self._worker_error: BaseException | None = None
         self._dev_queue: queue.Queue | None = None
         if num_threads > 0:
-            # prefetch is in units of get() calls: scale the single-batch
-            # queue by the stack depth so a whole superbatch can be buffered
-            # while the device runs the previous K-step program
-            self._queue: queue.Queue = queue.Queue(
-                maxsize=prefetch * max(1, stack))
+            # the queue holds units at the default depth — whole
+            # superbatches when stack >= 1 — so maxsize is directly in
+            # units of get() calls
+            self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
             self._stop = threading.Event()
+            worker_seeds = self._seq.spawn(num_threads)
+            # off-depth get(stack=K') calls (the final partial window)
+            # sample synchronously with their own stream rather than
+            # re-slicing queued full-depth units
+            self._sync_rng = np.random.default_rng(self._seq.spawn(1)[0])
             self._threads = [
                 threading.Thread(
                     target=self._worker,
                     args=(np.random.default_rng(s),),
                     daemon=True,
                 )
-                for s in self._seq.spawn(num_threads)
+                for s in worker_seeds
             ]
             for t in self._threads:
                 t.start()
@@ -135,12 +171,22 @@ class AsyncLoader:
                 self._uploader.start()
         else:
             self._rng = np.random.default_rng(self._seq)
+            self._sync_rng = self._rng
+
+    def _produce(self, stack: int, rng: np.random.Generator) -> dict:
+        """Sample one unit at the given depth: a (B, ...) batch when
+        ``stack < 1``, a (K, B, ...) superbatch otherwise."""
+        if stack < 1:
+            return make_host_batch(self.dataset, rng, self.batch_size,
+                                   self.scheme, self.augment, self.wire)
+        return make_host_superbatch(self.dataset, rng, self.batch_size,
+                                    stack, self.scheme, self.augment,
+                                    self.wire)
 
     def _worker(self, rng: np.random.Generator) -> None:
         try:
             while not self._stop.is_set():
-                batch = make_host_batch(self.dataset, rng, self.batch_size,
-                                        self.scheme, self.augment, self.wire)
+                batch = self._produce(self.stack, rng)
                 while not self._stop.is_set():
                     try:
                         self._queue.put(batch, timeout=0.1)
@@ -173,21 +219,21 @@ class AsyncLoader:
             except queue.Empty:
                 continue
 
-    def _host_batch(self) -> dict:
-        if self.num_threads > 0:
-            return self._drain(self._queue)
-        return make_host_batch(self.dataset, self._rng, self.batch_size,
-                               self.scheme, self.augment, self.wire)
-
     def _assemble(self, stack: int):
-        """Stack + device_put one (super)batch at the given depth."""
+        """One device_put-dispatched (super)batch at the given depth.
+
+        The default depth pulls ready-made units from the worker queue;
+        an off-depth request (final partial window) samples synchronously
+        — workers only ever build full-depth units, so there is nothing
+        to re-slice."""
+        if self.num_threads > 0 and stack == self.stack:
+            batch = self._drain(self._queue)
+        else:
+            batch = self._produce(stack, self._sync_rng)
         if stack < 1:
-            batch = self._host_batch()
             if self.sharding is not None:
                 return jax.device_put(batch, self.sharding)
             return jax.device_put(batch)
-        parts = [self._host_batch() for _ in range(stack)]
-        batch = {k: np.stack([p[k] for p in parts]) for k in parts[0]}
         if self.stack_sharding is not None:
             return jax.device_put(batch, self.stack_sharding)
         return jax.device_put(batch)

@@ -431,7 +431,10 @@ class Experiment:
             size = chunk.stop - chunk.start
             pad = bs - size
             batch = {
-                "packed": np.pad(packed[chunk], ((0, pad), (0, 0), (0, 0), (0, 0))),
+                # rank-agnostic pad: raw records are (n, 9, 19, 19), the
+                # nibble wire is (n, 1625)
+                "packed": np.pad(packed[chunk],
+                                 ((0, pad),) + ((0, 0),) * (packed.ndim - 1)),
                 "player": np.pad(player[chunk], (0, pad), constant_values=1),
                 "rank": np.pad(rank[chunk], (0, pad), constant_values=1),
                 "target": np.pad(target[chunk], (0, pad)),

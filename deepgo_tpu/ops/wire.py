@@ -10,13 +10,17 @@ kills >= 7, so clamping values to 15 provably preserves every expanded
 plane. That makes 4 bits per cell lossless for the model, and two cells
 pack into one byte.
 
-Layout: the 19-cell board rows pack pairwise along the last axis into 10
-bytes (cell 18 pairs with a zero pad): (..., 19, 19) uint8 ->
-(..., 19, 10) uint8, low nibble = even cell, high nibble = odd cell.
-Packing happens on host (NumPy, in the loader workers); unpacking is the
-first op of the jitted step (jnp), where XLA fuses the shifts into the
-expansion's comparisons. The on-disk shard format is unchanged — this is
-transfer encoding only.
+Layout: the whole (9, 19, 19) record flattens to 3,249 cells, pads one
+zero cell, and ADJACENT cells pack pairwise into 1,625 bytes (low nibble
+= even cell, high nibble = odd cell). Pairing adjacent bytes of the
+contiguous record — rather than round 4's stride-2 slicing within each
+19-cell board row — lets the host pack through a uint16 view in a few
+contiguous SIMD passes; the strided version measured 137 ms per 10k
+positions on the feed host, several times the memmap gather it sat
+behind (round-5 feed work, VERDICT item 5). Packing happens on host
+(NumPy, in the loader workers); unpacking is the first op of the jitted
+step (jnp), where XLA fuses the shifts into the expansion's comparisons.
+The on-disk shard format is unchanged — this is transfer encoding only.
 """
 
 from __future__ import annotations
@@ -26,29 +30,52 @@ import numpy as np
 import jax.numpy as jnp
 
 from .. import BOARD_SIZE
+from ..features import PACKED_CHANNELS
 
-WIRE_WIDTH = (BOARD_SIZE + 1) // 2  # 10 bytes per 19-cell row
+RECORD_CELLS = PACKED_CHANNELS * BOARD_SIZE * BOARD_SIZE  # 3,249
+WIRE_BYTES = (RECORD_CELLS + 1) // 2  # 1,625 per position
+
+# the uint16 pairing trick reads the even cell from the LOW byte
+assert np.little_endian, "nibble wire pack assumes a little-endian host"
+
+
+# positions per packing pass: the pack makes ~4 passes over its working
+# set, so chunking keeps those passes cache-resident — 10k positions in
+# one monolithic pass measured 3x slower than the same work in chunks
+# (84 ms vs 27 ms on the feed host; size is flat from 256 to 2048)
+_PACK_CHUNK = 1024
 
 
 def nibble_pack_np(packed: np.ndarray) -> np.ndarray:
-    """(..., 19, 19) uint8 -> (..., 19, 10) uint8 on host.
+    """(..., 9, 19, 19) uint8 -> (..., 1625) uint8 on host.
 
-    Values clamp to 15 first; see module docstring for why that is lossless
-    with respect to the expanded planes.
+    Values clamp to 15 first; see module docstring for why that is
+    lossless with respect to the expanded planes. The pad cell and the
+    uint16 view make every pass contiguous.
     """
-    assert packed.shape[-1] == BOARD_SIZE and packed.dtype == np.uint8
-    clamped = np.minimum(packed, 15)
-    even = clamped[..., 0::2]  # cells 0,2,...,18 -> all 10 output bytes
-    out = even.copy()
-    out[..., : BOARD_SIZE // 2] |= clamped[..., 1::2] << 4
-    return out
+    assert packed.dtype == np.uint8 and packed.shape[-3:] == (
+        PACKED_CHANNELS, BOARD_SIZE, BOARD_SIZE)
+    lead = packed.shape[:-3]
+    flat = packed.reshape(-1, RECORD_CELLS)
+    n = flat.shape[0]
+    out = np.empty((n, WIRE_BYTES), dtype=np.uint8)
+    buf = np.empty((min(n, _PACK_CHUNK), RECORD_CELLS + 1), dtype=np.uint8)
+    buf[:, RECORD_CELLS] = 0  # the pad cell, constant across chunks
+    for i in range(0, n, _PACK_CHUNK):
+        chunk = flat[i:i + _PACK_CHUNK]
+        b = buf[:len(chunk)]
+        np.minimum(chunk, 15, out=b[:, :RECORD_CELLS])
+        pairs = b.view(np.uint16)  # little-endian: low byte = even cell
+        out[i:i + _PACK_CHUNK] = ((pairs & 0x0F)
+                                  | ((pairs >> 4) & 0xF0)).astype(np.uint8)
+    return out.reshape(*lead, WIRE_BYTES)
 
 
 def nibble_unpack(wire: jnp.ndarray) -> jnp.ndarray:
-    """(..., 19, 10) uint8 -> (..., 19, 19) uint8 on device (jit-friendly)."""
+    """(..., 1625) uint8 -> (..., 9, 19, 19) uint8 on device (jit-friendly)."""
     lo = wire & jnp.uint8(0x0F)
     hi = wire >> jnp.uint8(4)
-    # interleave lo/hi back to 20 cells, drop the pad cell
-    out = jnp.stack([lo, hi], axis=-1).reshape(*wire.shape[:-1],
-                                               2 * WIRE_WIDTH)
-    return out[..., :BOARD_SIZE]
+    flat = jnp.stack([lo, hi], axis=-1).reshape(*wire.shape[:-1],
+                                                2 * WIRE_BYTES)
+    return flat[..., :RECORD_CELLS].reshape(
+        *wire.shape[:-1], PACKED_CHANNELS, BOARD_SIZE, BOARD_SIZE)

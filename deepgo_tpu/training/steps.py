@@ -35,7 +35,7 @@ def nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
 def _unwire(packed, wire: str):
     """Decode the transfer encoding of the "packed" batch entry (see
     deepgo_tpu.ops.wire): "packed" = raw (B, 9, 19, 19) records, "nibble" =
-    (B, 9, 19, 10) two-cells-per-byte. First op of every jitted step so the
+    (B, 1625) two-cells-per-byte. First op of every jitted step so the
     rest of the program always sees raw packed records."""
     if wire == "nibble":
         from ..ops.wire import nibble_unpack

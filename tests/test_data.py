@@ -52,6 +52,37 @@ def test_batch_contents(fixture_dataset):
     assert packed[0, 0].sum() == 0 and player[0] == 1
 
 
+def test_superbatch_single_gather_shapes(fixture_dataset):
+    # one K*B gather reshaped to (K, B, ...), nibble + augment included —
+    # the assembly that replaced the uploader's per-batch np.stack
+    from deepgo_tpu.data.loader import make_host_superbatch
+
+    ds = GoDataset(fixture_dataset, "test")
+    b = make_host_superbatch(ds, np.random.default_rng(0), batch_size=4,
+                             stack=3, scheme="uniform", augment=True,
+                             wire="nibble")
+    assert b["packed"].shape == (3, 4, 1625)  # nibble wire bytes
+    assert b["packed"].dtype == np.uint8
+    assert b["player"].shape == b["rank"].shape == b["target"].shape == (3, 4)
+    assert b["sym"].shape == (3, 4) and b["sym"].dtype == np.int32
+    assert ((b["target"] >= 0) & (b["target"] < 361)).all()
+
+
+def test_loader_off_depth_get_with_stacked_workers(fixture_dataset):
+    # workers build full-depth superbatches; an off-depth get (the final
+    # partial window) must sample synchronously and still deliver the
+    # requested (K', B, ...) shape
+    from deepgo_tpu.data.loader import AsyncLoader
+
+    ds = GoDataset(fixture_dataset, "test")
+    with AsyncLoader(ds, 4, scheme="uniform", seed=5, num_threads=2,
+                     prefetch=2, stack=3) as loader:
+        full = loader.get()
+        assert np.asarray(full["packed"]).shape == (3, 4, 9, 19, 19)
+        part = loader.get(stack=2)
+        assert np.asarray(part["packed"]).shape == (2, 4, 9, 19, 19)
+
+
 def test_game_sampling_in_range(fixture_dataset):
     ds = GoDataset(fixture_dataset, "validation")
     rng = np.random.default_rng(7)

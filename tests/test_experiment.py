@@ -235,6 +235,19 @@ def test_bad_batch_postmortem_capture(data_root, tmp_path):
     assert dump["packed"].shape == (cfg.batch_size, 9, 19, 19)
 
 
+def test_nibble_wire_trains_and_validates(data_root, tmp_path):
+    # the full streamed path under the nibble wire, validation included —
+    # the validation builder pads the wire-shaped packed array, which the
+    # (n, 1625) flat layout broke once before (rank-specific pad spec)
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"),
+                      wire_format="nibble", validation_size=20)
+    exp = Experiment(cfg)
+    exp.init()
+    exp.run(3)
+    out = exp.validate()
+    assert np.isfinite(out["cost"]) and 0.0 <= out["accuracy"] <= 1.0
+
+
 def test_unknown_wire_format_rejected(data_root, tmp_path):
     # a typo'd wire_format must fail loudly at init, not silently run the
     # packed (2x-bytes) path with a bogus label

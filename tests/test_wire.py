@@ -19,7 +19,7 @@ def test_roundtrip_preserves_clamped_values():
     rng = np.random.default_rng(0)
     packed = _random_packed(rng, (4,))
     wire = nibble_pack_np(packed)
-    assert wire.shape == (4, 9, 19, 10) and wire.dtype == np.uint8
+    assert wire.shape == (4, 1625) and wire.dtype == np.uint8
     out = np.asarray(nibble_unpack(wire))
     np.testing.assert_array_equal(out, np.minimum(packed, 15))
 
@@ -99,8 +99,8 @@ def test_loader_device_prefetch_and_wire(tmp_path):
         batches = [loader.get() for _ in range(4)]
         tail = loader.get(stack=0)  # off-depth request bypasses the queue
     for b in batches:
-        assert b["packed"].shape == (2, 8, 9, 19, 10)
-    assert tail["packed"].shape == (8, 9, 19, 10)
+        assert b["packed"].shape == (2, 8, 1625)
+    assert tail["packed"].shape == (8, 1625)
     # close() must terminate the uploader thread even when it was blocked
     # draining the host queue (it held no batch when the workers exited)
     import time
