@@ -38,6 +38,50 @@ def make_policy_fn(cfg: policy_cnn.ModelConfig, top_k: int = 5,
     return predict
 
 
+def make_sym_policy_fn(cfg: policy_cnn.ModelConfig,
+                       expand_backend: str = "xla"):
+    """predict(params, packed, player, rank) -> (B, 361) log-probs averaged
+    over the 8 dihedral board symmetries.
+
+    Go is invariant under the dihedral group and the training data is
+    augmented with it (ops/augment.py — the transform the reference stubbed
+    at dataloader.lua:41-44), but a finite net is only approximately
+    equivariant; ensembling the 8 views averages that error out. Each view
+    is transformed with the precomputed permutation table, pushed through
+    one 8B-board forward, softmaxed, mapped back to original coordinates
+    with the inverse table, and the PROBABILITIES are averaged (averaging
+    distributions, not logits, keeps the ensemble a proper mixture). The
+    averaged predictor is exactly equivariant by construction, which the
+    unit test asserts. Costs 8x FLOPs per board — measured against its
+    accuracy delta by tools/symmetry_eval.py.
+    """
+    from ..ops.augment import _PERM_NP, _TARGET_MAP_NP, NUM_SYMMETRIES
+    from .. import NUM_POINTS
+
+    expand_planes = get_expand_fn(expand_backend)
+
+    @jax.jit
+    def predict(params, packed, player, rank):
+        b, ch = packed.shape[0], packed.shape[1]
+        perm = jnp.asarray(_PERM_NP)          # (8, 361) gather tables
+        tmap = jnp.asarray(_TARGET_MAP_NP)    # (8, 361) inverse tables
+        flat = packed.reshape(b, ch, NUM_POINTS)
+        views = flat[:, :, perm]              # (B, C, 8, 361)
+        views = views.transpose(2, 0, 1, 3).reshape(
+            NUM_SYMMETRIES * b, ch, *packed.shape[2:])
+        rep = lambda v: jnp.tile(v, NUM_SYMMETRIES)  # noqa: E731
+        planes = expand_planes(views, rep(player), rep(rank),
+                               dtype=jnp.dtype(cfg.compute_dtype))
+        logits = policy_cnn.apply(params, planes, cfg)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        probs = probs.reshape(NUM_SYMMETRIES, b, NUM_POINTS)
+        # map view k's distribution back: orig point p sits at tmap[k, p]
+        back = jnp.take_along_axis(probs, tmap[:, None, :], axis=2)
+        return jnp.log(back.mean(axis=0) + 1e-30)
+
+    return predict
+
+
 def load_policy(checkpoint_path: str, top_k: int = 5):
     """(predict_fn, params, model_cfg) from a training checkpoint."""
     from ..experiments import ExperimentConfig

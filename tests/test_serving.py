@@ -38,6 +38,59 @@ def test_policy_fn_outputs():
     assert (np.diff(tp, axis=1) <= 1e-7).all()
 
 
+def test_sym_policy_fn_is_exactly_equivariant():
+    # averaging over the full dihedral group makes the predictor
+    # equivariant BY CONSTRUCTION: transforming the input must transform
+    # the output distribution, for any net (random init included) —
+    # the property that makes the 8-view ensemble a principled average
+    # rather than 8 unrelated evaluations
+    from deepgo_tpu.models.serving import make_sym_policy_fn
+    from deepgo_tpu.ops.augment import _PERM_NP, _TARGET_MAP_NP
+
+    cfg = ModelConfig(num_layers=2, channels=8, compute_dtype="float32")
+    params = init(jax.random.key(0), cfg)
+    predict = make_sym_policy_fn(cfg)
+    packed, player, rank = _inputs(b=4, seed=2)
+    base = np.asarray(predict(params, packed, player, rank))
+    assert base.shape == (4, 361)
+    np.testing.assert_allclose(np.exp(base).sum(-1), 1.0, rtol=1e-4)
+
+    k = 3  # an arbitrary non-identity symmetry
+    flat = np.asarray(packed).reshape(4, 9, 361)
+    t_packed = jnp.asarray(flat[:, :, _PERM_NP[k]].reshape(4, 9, 19, 19))
+    t_out = np.asarray(predict(params, t_packed, player, rank))
+    # the distribution must move WITH the board: original point p now
+    # lives at _TARGET_MAP_NP[k, p]
+    np.testing.assert_allclose(t_out[:, _TARGET_MAP_NP[k]], base,
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_sym_policy_fn_matches_reference_mixture():
+    # independent re-derivation: sym8(x) must equal
+    # log((1/8) sum_k  T_k^-1(softmax(net(T_k(x))))) computed here with
+    # the PLAIN predictor and the numpy tables — catching any error in
+    # the fused transform/inverse-map/average (a doubly-wrong map can
+    # still pass the equivariance test alone)
+    from deepgo_tpu.models.serving import make_sym_policy_fn
+    from deepgo_tpu.ops.augment import _PERM_NP, _TARGET_MAP_NP
+
+    cfg = ModelConfig(num_layers=2, channels=8, compute_dtype="float32")
+    params = init(jax.random.key(1), cfg)
+    plain = make_policy_fn(cfg, top_k=1)
+    sym = make_sym_policy_fn(cfg)
+    packed, player, rank = _inputs(b=4, seed=5)
+    flat = np.asarray(packed).reshape(4, 9, 361)
+
+    mix = np.zeros((4, 361))
+    for k in range(8):
+        view = jnp.asarray(flat[:, :, _PERM_NP[k]].reshape(4, 9, 19, 19))
+        logp = np.asarray(plain(params, view, player, rank)["log_probs"])
+        mix += np.exp(logp)[:, _TARGET_MAP_NP[k]]
+    expected = np.log(mix / 8 + 1e-30)
+    out = np.asarray(sym(params, packed, player, rank))
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=1e-5)
+
+
 def test_load_policy_from_checkpoint(tmp_path):
     import os
     from conftest import REPO_ROOT

@@ -94,6 +94,39 @@ run_feed() {
   grep streamed_training runs/r5logs/feed.log | tail -4
 }
 
+# verdict item 8: symmetry-averaged inference measured at full-split
+# scale on the big nets (the CPU pilot read +0.71 top-1 on 3L/64);
+# runs after large13b so the annealed checkpoint gets measured too
+run_symm() {
+  stage symm
+  for name in converge-12L128 large13-ft; do
+    local mark=runs/r5logs/done_symm_$name
+    [ -f "$mark" ] && { echo "symm $name already done"; continue; }
+    read -r CKPT STEP <<< "$(find_ckpt $name)"
+    if [ -z "${CKPT:-}" ]; then
+      echo "symm $name incomplete (no checkpoint yet)"
+      continue
+    fi
+    # a save-on-validate checkpoint exists mid-anneal; measuring it and
+    # marking done would skip the FINAL annealed net this stage is for
+    if [ "$name" = large13-ft ] && [ "${STEP:-0}" -lt $LARGE_TOTAL ]; then
+      echo "symm $name incomplete (still annealing: step $STEP/$LARGE_TOTAL)"
+      continue
+    fi
+    canary || { echo "canary failed; skipping symm $name"; return 1; }
+    supervise runs/r5logs/symm_$name.log 600 \
+      timeout 3600 python -u tools/symmetry_eval.py \
+      --checkpoint "$CKPT" --batch 1024 \
+      --out docs/symmetry_eval.jsonl \
+      >> runs/r5logs/symm_$name.log 2>&1
+    local rc=$?
+    [ $rc -eq 0 ] && touch "$mark"
+    echo "symm $name rc=$rc"
+    tail -3 runs/r5logs/symm_$name.log
+  done
+  return 0
+}
+
 if [ "${1:-}" = "--until-done" ]; then
   for attempt in $(seq 1 60); do
     echo "=== until-done attempt $attempt [$(date -u +%H:%M:%S)] ==="
@@ -112,7 +145,7 @@ if [ "${1:-}" = "--until-done" ]; then
 fi
 
 if [ $# -eq 0 ]; then
-  set -- bench large13b feed
+  set -- bench large13b feed symm
 fi
 for s in "$@"; do run_$s; done
 echo "=== queue done [$(date -u +%H:%M:%S)] ==="
