@@ -3,20 +3,33 @@
 The 55% KGS top-1 north star needs ~27M human positions that do not exist
 in this zero-egress environment (BASELINE.md; reference README.md:5), so
 the accuracy axis is exercised on data the framework generates itself:
-arena games between the scripted baselines (HeuristicAgent, OnePlyAgent),
-written as ranked SGFs and pushed through the exact same
+arena games between the scripted baselines (HeuristicAgent, OnePlyAgent)
+plus any checkpoint-backed agents mixed in via ``--extra``, written as
+ranked SGFs and pushed through the exact same
 transcription -> shard -> loader -> train pipeline a real corpus would use
 (reference pipeline anchors: makedata.lua:517-576, data.lua:29-80).
 
-Agent identity is encoded in the dan-rank tags (oneply=8d, heuristic=4d),
-so the model can condition on "player strength" through the rank planes
-exactly like KGS dan ranks (reference dataloader.lua:12-13,87). Game pairs
-cycle through the three distinct matchups for move-distribution diversity
-(colors alternate inside each chunk, so both color assignments of the
-mixed pair occur — arena.play_match).
+Agent identity is encoded in the dan-rank tags (oneply=8d, heuristic=4d,
+``--extra SPEC=RANK`` as given), so the model can condition on "player
+strength" through the rank planes exactly like KGS dan ranks (reference
+dataloader.lua:12-13,87). Every unordered agent pairing (self-pairs
+included) is cycled for move-distribution diversity, and colors alternate
+inside each chunk so both color assignments occur (arena.play_match).
+
+``--opening-plies N`` starts every game from N independent uniformly-
+random legal moves (per GAME, not per pair): round 4 measured per-game
+random openings worth +6.6 points of downstream strength on the
+expert-corpus axis — trajectory diversity is the difference between a
+corpus a model saturates at 400k positions and one where the data axis
+keeps paying (round-4 verdict items 3/weak-2).
 
 Usage:
   python tools/make_corpus.py --out data/corpus --positions 5000000
+  # round-5 diversified recipe:
+  python tools/make_corpus.py --out data/corpus2 --positions 3400000 \
+      --opening-plies 8 \
+      --extra search:runs/<id>/checkpoint.npz=9 \
+      --extra checkpoint:runs/<id>/checkpoint.npz=6
 """
 
 from __future__ import annotations
@@ -40,11 +53,41 @@ def split_of(gid: int) -> str:
     return {1: "validation", 2: "test"}.get(r, "train")
 
 
+def build_pool(extra: list[str], seed: int,
+               temperature: float) -> dict[str, tuple[arena.Agent, int]]:
+    """name -> (agent, rank): the scripted baselines plus --extra specs.
+
+    Each extra is SPEC=RANK (e.g. search:ckpt.npz=9); the spec goes
+    through arena._make_agent, so every agent family the arena knows is
+    available to the generator. Sampling policy agents (checkpoint:/
+    model:) get ``temperature`` for extra move diversity; the search
+    family ignores it (deterministic re-rankers).
+    """
+    pool: dict[str, tuple[arena.Agent, int]] = {
+        "heuristic": (arena.HeuristicAgent(), RANK_OF["heuristic"]),
+        "oneply": (arena.OnePlyAgent(), RANK_OF["oneply"]),
+    }
+    for i, item in enumerate(extra or []):
+        spec, _, rank_s = item.rpartition("=")
+        assert spec and rank_s.isdigit(), (
+            f"--extra wants SPEC=RANK, got {item!r}")
+        agent = arena._make_agent(spec, seed + 1000 + i, temperature,
+                                  int(rank_s))
+        pool[f"x{i}-{agent.name}"] = (agent, int(rank_s))
+    return pool
+
+
 def generate(out: str, target_positions: int, chunk: int, max_moves: int,
-             seed: int) -> dict:
-    pairs = [("oneply", "oneply"), ("oneply", "heuristic"),
-             ("heuristic", "heuristic")]
-    agents = {"heuristic": arena.HeuristicAgent(), "oneply": arena.OnePlyAgent()}
+             seed: int, opening_plies: int = 0,
+             pool: dict[str, tuple[arena.Agent, int]] | None = None) -> dict:
+    if pool is None:
+        pool = build_pool([], seed, 0.0)
+    # strongest first; with the default pool this reproduces the legacy
+    # pair cycle [(oneply,oneply), (oneply,heuristic), (heuristic,
+    # heuristic)] so `--positions N --seed 0` still regenerates the
+    # round-4 corpus bit-exactly (fresh-machine recipe, RESULTS.md)
+    names = sorted(pool, key=lambda n: (-pool[n][1], n))
+    pairs = [(a, b) for i, a in enumerate(names) for b in names[i:]]
     for split in ("train", "validation", "test"):
         os.makedirs(os.path.join(out, "sgf", split), exist_ok=True)
 
@@ -54,8 +97,13 @@ def generate(out: str, target_positions: int, chunk: int, max_moves: int,
     while totals["positions"] < target_positions:
         name_a, name_b = pairs[round_idx % len(pairs)]
         games, scores, stats = arena.play_match(
-            agents[name_a], agents[name_b], n_games=chunk,
-            max_moves=max_moves, seed=seed + round_idx)
+            pool[name_a][0], pool[name_b][0], n_games=chunk,
+            max_moves=max_moves, seed=seed + round_idx,
+            opening_plies=opening_plies,
+            # per-GAME openings: a deterministic self-pair from a
+            # pair-shared opening is the same game twice, and duplicates
+            # can straddle the train/validation split downstream
+            shared_openings=False)
         totals["truncated"] += stats["truncated"]
         for i, (g, s) in enumerate(zip(games, scores)):
             gid = totals["games"]
@@ -70,7 +118,7 @@ def generate(out: str, target_positions: int, chunk: int, max_moves: int,
             with open(path, "w") as f:
                 f.write(to_sgf(
                     g,
-                    black_rank=RANK_OF[black], white_rank=RANK_OF[white],
+                    black_rank=pool[black][1], white_rank=pool[white][1],
                     result=s.result_string() if done else None, komi=7.5))
         round_idx += 1
         rate = totals["positions"] / (time.time() - t0)
@@ -88,13 +136,28 @@ def main(argv=None) -> None:
                     help="games advanced in lockstep per match call")
     ap.add_argument("--max-moves", type=int, default=350)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--opening-plies", type=int, default=0,
+                    help="independent random opening moves per game "
+                         "(trajectory diversity; 8 = round-5 recipe)")
+    ap.add_argument("--extra", action="append", default=[],
+                    help="additional agent as SPEC=RANK (repeatable), e.g. "
+                         "search:runs/<id>/checkpoint.npz=9")
+    ap.add_argument("--temperature", type=float, default=0.25,
+                    help="sampling temperature for checkpoint:/model: "
+                         "--extra agents (diversity; search family "
+                         "ignores it)")
     ap.add_argument("--transcribe-workers", type=int,
                     default=max(1, (os.cpu_count() or 2) - 1))
     ap.add_argument("--skip-transcribe", action="store_true")
     args = ap.parse_args(argv)
 
+    from deepgo_tpu.utils import honor_platform_env
+
+    honor_platform_env()
+    pool = build_pool(args.extra, args.seed, args.temperature)
+    print({name: (agent.name, rank) for name, (agent, rank) in pool.items()})
     totals = generate(args.out, args.positions, args.chunk, args.max_moves,
-                      args.seed)
+                      args.seed, args.opening_plies, pool)
     print(totals)
 
     if not args.skip_transcribe:

@@ -94,6 +94,30 @@ run_feed() {
   grep streamed_training runs/r5logs/feed.log | tail -4
 }
 
+# verdict item 3: the corpus-diversity lever applied to the ACCURACY axis
+# — re-measure the 400k and full-corpus points of the 12L/128 curve on the
+# diversified corpus2 (per-game openings, mixed-rank trained-agent pool).
+# Done = the two-point curve shows whether the data axis is live again.
+run_curve2() {
+  stage curve2
+  if [ "$(cat docs/accuracy_curve2.jsonl 2>/dev/null | wc -l)" -ge 2 ]; then
+    echo "curve2 already has 2 points; skipping"; return 0
+  fi
+  if [ ! -f data/corpus2/processed/test/games.json ]; then
+    echo "curve2 incomplete (corpus2 still generating)"; return 0
+  fi
+  canary || { echo "canary failed; skipping curve2"; return 1; }
+  supervise runs/r5logs/curve2.log 600 \
+    timeout 14400 python -u tools/accuracy_curve.py \
+    --data-root data/corpus2/processed \
+    --budgets 400000,99000000 --iters 4000 \
+    --out docs/accuracy_curve2.jsonl \
+    --set num_layers=12 channels=128 batch_size=512 \
+    >> runs/r5logs/curve2.log 2>&1
+  echo "curve2 rc=$?"
+  tail -2 runs/r5logs/curve2.log
+}
+
 # verdict item 8: symmetry-averaged inference measured at full-split
 # scale on the big nets (the CPU pilot read +0.71 top-1 on 3L/64);
 # runs after large13b so the annealed checkpoint gets measured too
@@ -145,7 +169,7 @@ if [ "${1:-}" = "--until-done" ]; then
 fi
 
 if [ $# -eq 0 ]; then
-  set -- bench large13b feed symm
+  set -- bench large13b feed curve2 symm
 fi
 for s in "$@"; do run_$s; done
 echo "=== queue done [$(date -u +%H:%M:%S)] ==="

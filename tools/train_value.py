@@ -46,10 +46,20 @@ from deepgo_tpu.training.optimizers import sgd  # noqa: E402
 from deepgo_tpu.experiments.checkpoint import save_checkpoint  # noqa: E402
 
 
-def decided_indices(ds: GoDataset) -> np.ndarray:
+def decided_indices(ds: GoDataset, equal_rank: bool = False) -> np.ndarray:
+    """Positions in decided games; ``equal_rank`` keeps only games whose
+    players share a dan rank. The mixed-rank corpus leaks the pairing
+    through the rank planes (8d-vs-4d is ~always an 8d win, so outcome
+    "accuracy" starts from ~55% chance — RESULTS.md round-4 value table);
+    on the equal-rank slice the planes carry no outcome information and
+    accuracy measures board reading against ~50% chance."""
     assert ds.winner is not None, (
         f"no winner.npy in {ds.dir} — run tools/winner_index.py first")
-    return np.nonzero(ds.winner != 0)[0]
+    ix = np.nonzero(ds.winner != 0)[0]
+    if equal_rank:
+        meta = ds.meta[ix]
+        ix = ix[meta[:, M_BLACK_RANK] == meta[:, M_WHITE_RANK]]
+    return ix
 
 
 def gather(ds: GoDataset, idx: np.ndarray):
@@ -101,6 +111,10 @@ def main(argv=None) -> None:
     ap.add_argument("--val-size", type=int, default=4096)
     ap.add_argument("--print-interval", type=int, default=100)
     ap.add_argument("--out", default="runs/value")
+    ap.add_argument("--equal-rank", action="store_true",
+                    help="train/evaluate only on games between equal-rank "
+                         "players: removes the rank-plane outcome shortcut "
+                         "so accuracy is measured against ~50%% chance")
     args = ap.parse_args(argv)
 
     cfg = value_cnn.ValueConfig(num_layers=args.num_layers,
@@ -112,16 +126,20 @@ def main(argv=None) -> None:
     roots = [r for r in args.data_root.split(",") if r]
     trains = [GoDataset(r, "train") for r in roots]
     vals = [GoDataset(r, "validation") for r in roots]
-    tr_sets = [(d, decided_indices(d)) for d in trains]
+    tr_sets = [(d, decided_indices(d, args.equal_rank)) for d in trains]
     rng = np.random.default_rng(args.seed)
     sizes = np.array([len(ix) for _, ix in tr_sets], dtype=np.float64)
+    assert sizes.sum() > 0, (
+        "no decided training positions after filtering"
+        + (" (--equal-rank: no equal-rank decided games in these roots)"
+           if args.equal_rank else ""))
     weights = sizes / sizes.sum()
     # validation probe drawn from each root proportionally to its TRAIN
     # decided-position weight — the probe mirrors the sampling mixture
     # the multinomial batches use, not each root's own validation size
     va_parts = []
     for w, d in zip(weights, vals):
-        ix = decided_indices(d)
+        ix = decided_indices(d, args.equal_rank)
         want = max(1, int(round(args.val_size * w))) if w > 0 else 0
         take = min(want, len(ix))
         if take == 0:
@@ -141,10 +159,15 @@ def main(argv=None) -> None:
     assert va_parts, "no root contributed validation positions"
     va_batch = tuple(np.concatenate([p[j] for p in va_parts])
                      for j in range(4))
-    print(f"train positions (decided games): "
-          f"{int(sizes.sum()):,} of {sum(len(d) for d in trains):,} "
-          f"across {len(roots)} root(s); "
-          f"val probe {len(va_batch[0]):,}", flush=True)
+    # the probe's majority-class rate IS the chance floor for outcome
+    # accuracy — print it so "accuracy X%" is always read against it
+    # (mixed-rank corpora sit near 55%; equal-rank near 50%)
+    z_rate = float(np.mean(va_batch[3]))
+    print(f"train positions (decided{' equal-rank' if args.equal_rank else ''} "
+          f"games): {int(sizes.sum()):,} of "
+          f"{sum(len(d) for d in trains):,} across {len(roots)} root(s); "
+          f"val probe {len(va_batch[0]):,}, chance floor "
+          f"{max(z_rate, 1 - z_rate):.3f}", flush=True)
 
     def sample_batch(n: int):
         if len(tr_sets) == 1:
@@ -187,6 +210,7 @@ def main(argv=None) -> None:
         "config": {"num_layers": cfg.num_layers, "channels": cfg.channels,
                    "head_hidden": cfg.head_hidden},
         "step": args.iters,
+        "equal_rank": args.equal_rank,
         "validation_history": history,
     })
     print(f"saved {path}")
