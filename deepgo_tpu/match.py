@@ -52,6 +52,8 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
     opening produces the SAME game twice, and the duplicates can
     straddle train/validation splits downstream.
     """
+    import sys
+
     rng = np.random.default_rng(seed)
     games = [GameState() for _ in range(n_games)]
     # black_agent[i] plays BLACK in game i
@@ -59,11 +61,21 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
                 for i in range(n_games)]
     plies = 0
     t0 = time.time()
+    last_report = t0
 
     while True:
         live = [i for i, g in enumerate(games) if not g.done]
         if not live:
             break
+        # long matches (a 1,000-game pin is hours on a host core) print
+        # nothing until scoring without this: a heartbeat on stderr keeps
+        # the run observable and log-stall supervisors satisfied
+        now = time.time()
+        if now - last_report > 120:
+            last_report = now
+            print(f"# match {n_games - len(live)}/{n_games} games done, "
+                  f"{plies:,} plies, {plies / (now - t0):.1f} pos/sec",
+                  file=sys.stderr, flush=True)
         packed = summarize_states([games[i] for i in live])
         players = np.array([games[i].player for i in live], dtype=np.int32)
         legal = legal_mask(packed, players, [games[i] for i in live])
