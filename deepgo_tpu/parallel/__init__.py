@@ -6,3 +6,4 @@ from .mesh import (  # noqa: F401
     replicated_sharding,
     superbatch_sharding,
 )
+from .zero import shard_opt_state, sharded_fraction, zero_sharding  # noqa: F401

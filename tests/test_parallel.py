@@ -14,7 +14,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepgo_tpu.models import ModelConfig, init
-from deepgo_tpu.parallel import data_sharding, make_mesh, replicated_sharding
+from deepgo_tpu.parallel import (data_sharding, make_mesh,
+                                replicated_sharding, shard_opt_state,
+                                sharded_fraction)
 from deepgo_tpu.parallel.tensor import shard_params
 from deepgo_tpu.training import make_train_step, sgd
 
@@ -36,41 +38,45 @@ def _batch(bs=32, seed=0):
     }
 
 
-def _run_steps(mesh, tp=False, steps=3):
+def _run_steps(mesh, tp=False, steps=3, zero=False, momentum=0.0):
     # float32 compute: bf16 accumulation order would differ across meshes
     cfg = ModelConfig(num_layers=3, channels=16, compute_dtype="float32")
-    opt = sgd(0.05, rate_decay=1e-4)
+    opt = sgd(0.05, rate_decay=1e-4, momentum=momentum)
     params = init(jax.random.key(0), cfg)
     if tp:
         params = shard_params(params, mesh)
     else:
         params = jax.device_put(params, replicated_sharding(mesh))
-    opt_state = jax.device_put(opt.init(params), replicated_sharding(mesh))
+    if zero:
+        opt_state = shard_opt_state(opt.init(params), mesh)
+    else:
+        opt_state = jax.device_put(opt.init(params),
+                                   replicated_sharding(mesh))
     step = make_train_step(cfg, opt)
     losses = []
     for i in range(steps):
         batch = jax.device_put(_batch(seed=i), data_sharding(mesh))
         params, opt_state, loss = step(params, opt_state, batch)
         losses.append(float(loss))
-    return losses, params
+    return losses, params, opt_state
 
 
 def test_data_parallel_matches_single_device():
-    single, p1 = _run_steps(make_mesh(1, 1))
-    dp8, p8 = _run_steps(make_mesh(8, 1))
+    single, p1, _ = _run_steps(make_mesh(1, 1))
+    dp8, p8, _ = _run_steps(make_mesh(8, 1))
     np.testing.assert_allclose(single, dp8, rtol=1e-5)
     for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p8)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
 
 
 def test_tensor_parallel_matches_single_device():
-    single, _ = _run_steps(make_mesh(1, 1))
-    tp, _ = _run_steps(make_mesh(2, 4), tp=True)
+    single, _, _ = _run_steps(make_mesh(1, 1))
+    tp, _, _ = _run_steps(make_mesh(2, 4), tp=True)
     np.testing.assert_allclose(single, tp, rtol=1e-5)
 
 
 def test_dp_times_tp_mesh():
-    losses, params = _run_steps(make_mesh(4, 2), tp=True)
+    losses, params, _ = _run_steps(make_mesh(4, 2), tp=True)
     assert losses[0] > losses[-1] or losses[0] == pytest.approx(losses[-1], abs=1.0)
     # hidden conv weights actually sharded over the model axis
     w1 = params["layers"][1]["w"]
@@ -83,3 +89,37 @@ def test_batch_sharding_layout():
     batch = jax.device_put(_batch(), data_sharding(mesh))
     shard_shapes = {s.data.shape for s in batch["packed"].addressable_shards}
     assert shard_shapes == {(4, 9, 19, 19)}  # 32/8 per device
+
+
+def test_zero_sharded_update_matches_replicated():
+    # ZeRO-1 weight-update sharding (parallel/zero.py, arXiv:2004.13336):
+    # placing the optimizer state sharded over the data axis must change
+    # WHERE the update computes, never what it computes. Momentum makes
+    # the state a full param-shaped buffer, so the test exercises real
+    # sharded state, not just the scalar rate.
+    rep, p_rep, _ = _run_steps(make_mesh(8, 1), momentum=0.9)
+    zero, p_zero, opt_state = _run_steps(make_mesh(8, 1), momentum=0.9,
+                                         zero=True)
+    np.testing.assert_allclose(rep, zero, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_rep), jax.tree.leaves(p_zero)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+    # the velocity buffers really are distributed (the scalar rate and
+    # any indivisible leaves replicate; everything else shards)
+    assert sharded_fraction(opt_state) > 0.9
+    v1 = opt_state["velocity"]["layers"][1]["w"]
+    assert not v1.sharding.is_fully_replicated
+
+
+def test_zero_composes_with_tensor_parallel():
+    # under dp x tp the params are channel-sharded on "model"; ZeRO must
+    # ADD "data" on a free axis of each buffer, not reshard "model" away
+    losses, _, opt_state = _run_steps(make_mesh(4, 2), tp=True, zero=True,
+                                      momentum=0.9)
+    assert np.isfinite(losses).all()
+    # a hidden conv's velocity carries BOTH axes: in-channels on "data"
+    # (ZeRO) and out-channels on "model" (inherited tensor parallelism) —
+    # the exact spec is the guard (a bare sharded-fraction check would
+    # pass from the inherited "model" sharding alone)
+    v1 = opt_state["velocity"]["layers"][1]["w"]
+    assert v1.sharding.spec == P(None, None, "data", "model")
