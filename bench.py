@@ -177,8 +177,15 @@ def _preflight_probe(mode: str = "inference") -> None:
 
     if os.environ.get("BENCH_PREFLIGHT") == "0":
         return
-    timeout_s = float(os.environ.get("BENCH_PREFLIGHT_S", "90"))
-    tries = max(1, int(os.environ.get("BENCH_PREFLIGHT_TRIES", "4")))
+    # defaults keep the WORST failure path at 360s (3 x 60s canaries +
+    # 60/120s backoffs) — exactly the failure envelope the round-4 driver
+    # demonstrably waited out (BENCH_r04.json: 3 x 60s probes + 2 x 60s
+    # flat backoffs, rc recorded with the JSON parsed). A driver kill
+    # mid-preflight would emit NO JSON line, strictly worse than the
+    # stale fallback, so the defaults must never exceed a proven window.
+    # Queue scripts with a known 2400s envelope can raise these via env.
+    timeout_s = float(os.environ.get("BENCH_PREFLIGHT_S", "60"))
+    tries = max(1, int(os.environ.get("BENCH_PREFLIGHT_TRIES", "3")))
     backoff_s = float(os.environ.get("BENCH_PREFLIGHT_BACKOFF_S", "60"))
     # the probe must dial the same backend the benchmark will use, so it
     # re-asserts JAX_PLATFORMS exactly like honor_platform_env (the

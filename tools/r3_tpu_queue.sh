@@ -156,8 +156,8 @@ run_bench() {
       echo "bench $mode already done"; continue
     fi
     canary || { echo "canary failed; skipping bench $mode"; return 1; }
-    # 2400s envelope: worst-case preflight (4 failed 90s canaries +
-    # 60/120/240s backoffs = 780s) + the 900s bench watchdog must both
+    # 2400s envelope: worst-case preflight (3 failed 60s canaries +
+    # 60/120s backoffs = 360s) + the 900s bench watchdog must both
     # fit, or the outer timeout SIGKILLs before any JSON line is emitted
     timeout 2400 python bench.py --mode $mode \
       > runs/r3logs/bench_$mode.json 2> runs/r3logs/bench_$mode.err

@@ -24,7 +24,7 @@ run_bench() {
       echo "bench $mode already done"; continue
     fi
     canary || { echo "canary failed; skipping bench $mode"; return 1; }
-    # 2400s: worst-case preflight (780s) + 900s watchdog, same envelope
+    # 2400s: worst-case preflight (360s) + 900s watchdog, same envelope
     # arithmetic as the r3/r5 queues
     timeout 2400 python bench.py --mode $mode \
       > runs/r4logs/bench_$mode.json 2> runs/r4logs/bench_$mode.err

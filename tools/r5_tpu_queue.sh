@@ -31,7 +31,7 @@ run_bench() {
       echo "bench $mode already done"; continue
     fi
     canary || { echo "canary failed; skipping bench $mode"; return 1; }
-    # 2400s envelope: worst-case preflight (780s) + 900s bench watchdog
+    # 2400s envelope: worst-case preflight (360s) + 900s bench watchdog
     timeout 2400 python bench.py --mode $mode \
       > runs/r5logs/bench_$mode.json 2> runs/r5logs/bench_$mode.err
     echo "bench $mode rc=$?"
