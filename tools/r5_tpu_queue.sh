@@ -123,7 +123,7 @@ run_curve2() {
 # runs after large13b so the annealed checkpoint gets measured too
 run_symm() {
   stage symm
-  for name in converge-12L128 large13-ft; do
+  for name in converge-12L128 large13-256 large13-ft; do
     local mark=runs/r5logs/done_symm_$name
     [ -f "$mark" ] && { echo "symm $name already done"; continue; }
     read -r CKPT STEP <<< "$(find_ckpt $name)"
