@@ -1,0 +1,70 @@
+"""Corpus generator: pool construction, splits, ranks, opening diversity."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+import make_corpus  # noqa: E402
+
+
+def _moves(sgf_text):
+    import re
+
+    return re.findall(r";[BW]\[(\w\w)\]", sgf_text)
+
+
+def test_generate_scripted_splits_ranks_and_openings(tmp_path):
+    out = str(tmp_path / "corpus")
+    pool = make_corpus.build_pool([], seed=5, temperature=0.0)
+    totals = make_corpus.generate(out, target_positions=600, chunk=16,
+                                  max_moves=60, seed=5, opening_plies=4,
+                                  pool=pool)
+    assert totals["games"] >= 16 and totals["positions"] >= 600
+    sgfs = []
+    for split in ("train", "validation", "test"):
+        d = os.path.join(out, "sgf", split)
+        sgfs += [os.path.join(d, f) for f in os.listdir(d)]
+    assert len(sgfs) == totals["games"]
+    # gid % 50 split rule puts gid 1 in validation and gid 2 in test, so
+    # both side splits are populated from the very first chunk
+    assert os.listdir(os.path.join(out, "sgf", "validation"))
+    assert os.listdir(os.path.join(out, "sgf", "test"))
+    # the FIRST chunk (gids 0..15, ordered by basename across splits) is
+    # the oneply self-pair: 8d vs 8d rank tags from the pool
+    texts = [open(f).read()
+             for f in sorted(sgfs, key=os.path.basename)[:16]]
+    assert all("BR[8d]" in t and "WR[8d]" in t for t in texts)
+    # per-game openings: the first 4 moves must NOT be identical across
+    # all games of the deterministic self-pair chunk (the diversity the
+    # round-4 +6.6-point lever depends on)
+    openings = {tuple(_moves(t)[:4]) for t in texts}
+    assert len(openings) > 8
+
+
+def test_build_pool_extra_spec_and_rank():
+    pool = make_corpus.build_pool(["model:small=7"], seed=0, temperature=0.5)
+    assert set(pool) == {"heuristic", "oneply", "x0-init-small"}
+    agent, rank = pool["x0-init-small"]
+    assert rank == 7 and agent.temperature == 0.5
+
+
+def test_build_pool_rejects_malformed_extra():
+    with pytest.raises(AssertionError, match="SPEC=RANK"):
+        make_corpus.build_pool(["model:small"], seed=0, temperature=0.0)
+
+
+def test_default_pool_preserves_legacy_pair_cycle(tmp_path):
+    # the bit-exact regeneration of the round-4 corpus depends on the
+    # default pool ordering strongest-first: (oneply,oneply) must be the
+    # first pairing (fresh-machine recipe, RESULTS.md)
+    pool = make_corpus.build_pool([], seed=0, temperature=0.0)
+    names = sorted(pool, key=lambda n: (-pool[n][1], n))
+    pairs = [(a, b) for i, a in enumerate(names) for b in names[i:]]
+    assert pairs == [("oneply", "oneply"), ("oneply", "heuristic"),
+                     ("heuristic", "heuristic")]
