@@ -53,6 +53,28 @@ def host_sampling_rate(data_root: str, batch_size: int, wire: str,
             "samples_per_sec": round(n / (time.time() - t0), 1)}
 
 
+def host_superbatch_rate(data_root: str, batch_size: int, stack: int,
+                         wire: str, seconds: float = 5.0) -> dict:
+    """Host-side SUPERBATCH assembly rate — the unit the loader workers
+    actually build since round 5 (one K*B gather + chunked wire encode,
+    deepgo_tpu.data.loader.make_host_superbatch)."""
+    import numpy as np
+
+    from deepgo_tpu.data import GoDataset
+    from deepgo_tpu.data.loader import make_host_superbatch
+
+    ds = GoDataset(data_root, "train")
+    rng = np.random.default_rng(0)
+    make_host_superbatch(ds, rng, batch_size, stack, "uniform", wire=wire)
+    n = 0
+    t0 = time.time()
+    while time.time() - t0 < seconds:
+        make_host_superbatch(ds, rng, batch_size, stack, "uniform", wire=wire)
+        n += batch_size * stack
+    return {"kind": "host_superbatch", "wire": wire, "stack": stack,
+            "samples_per_sec": round(n / (time.time() - t0), 1)}
+
+
 def streamed_training_rate(cfg: ExperimentConfig, iters: int) -> dict:
     """Live streamed training samples/sec for one feed configuration.
 
@@ -114,6 +136,8 @@ def main(argv=None) -> None:
 
     for wire in ("packed", "nibble"):
         record(host_sampling_rate(args.data_root, base.batch_size, wire))
+        record(host_superbatch_rate(args.data_root, base.batch_size,
+                                    base.steps_per_call, wire))
     for wire, dev_prefetch in (("packed", 0), ("packed", 2),
                                ("nibble", 0), ("nibble", 2)):
         cfg = base.replace(wire_format=wire, device_prefetch=dev_prefetch)
