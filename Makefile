@@ -1,6 +1,6 @@
 # Developer entry points. `scripts/setup.sh` chains native + data + test.
 
-.PHONY: native data test test-full bench smoke clean
+.PHONY: native data test test-full verify-faults bench smoke clean
 
 native:
 	$(MAKE) -C native
@@ -14,6 +14,10 @@ test:
 
 test-full:  # every golden position, not the sampled sweep
 	DEEPGO_GOLDEN_FULL=1 python -m pytest tests/ -q
+
+verify-faults:  # crash-safety + fault-injection suite, slow kill-and-resume included
+	JAX_PLATFORMS=cpu python -m pytest tests/test_atomicio.py \
+	    tests/test_faults.py tests/test_checkpoint.py tests/test_resume.py -q
 
 bench:
 	python bench.py

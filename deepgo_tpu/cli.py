@@ -50,14 +50,35 @@ def parse_overrides(pairs: list[str]) -> dict:
 
 
 def cmd_train(args) -> None:
-    if args.resume:
+    if args.resume and args.auto_resume:
+        raise SystemExit("--resume and --auto-resume are mutually exclusive")
+    if args.auto_resume:
+        # elastic restart loop: --iters is the TOTAL step target, so
+        # re-running the identical command after any number of kills
+        # converges on the same final state as one uninterrupted run
+        # (docs/robustness.md)
+        exp = Experiment.auto_resume(args.auto_resume,
+                                     overrides=parse_overrides(args.set))
+        if exp.step > 0:
+            print(f"auto-resumed {exp.id} at step {exp.step}")
+        else:
+            print(f"experiment {exp.id} (no valid checkpoint in "
+                  f"{args.auto_resume}; starting fresh)")
+        iters = args.iters - exp.step
+        if iters <= 0:
+            print(f"step {exp.step} already meets --iters {args.iters}; "
+                  f"nothing to do")
+            return
+    elif args.resume:
         exp = Experiment.load(args.resume)
         print(f"resumed {exp.id} at step {exp.step}")
+        iters = args.iters
     else:
         config = ExperimentConfig(**parse_overrides(args.set))
         exp = Experiment(config)
         print(f"experiment {exp.id}")
-    summary = exp.run(args.iters)
+        iters = args.iters
+    summary = exp.run(iters)
     print(f"final EWMA cost {summary['final_ewma']:.4f}; "
           f"checkpoint at {exp.save()}")
 
@@ -94,8 +115,14 @@ def main(argv=None) -> None:
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("train", help="train or resume an experiment")
-    p.add_argument("--iters", type=int, required=True)
+    p.add_argument("--iters", type=int, required=True,
+                   help="steps to run (TOTAL step target with --auto-resume)")
     p.add_argument("--resume", help="checkpoint path to continue from")
+    p.add_argument("--auto-resume", metavar="RUN_DIR",
+                   help="continue from the newest valid checkpoint in "
+                        "RUN_DIR (corrupt ones are skipped), or start a "
+                        "fresh run there; --set applies to fresh starts "
+                        "only")
     p.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
     p.set_defaults(fn=cmd_train)
 

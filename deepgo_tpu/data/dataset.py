@@ -34,6 +34,8 @@ import os
 import numpy as np
 
 from ..features import PACKED_CHANNELS
+from ..utils import faults
+from ..utils.retry import retry_with_backoff
 from .. import BOARD_SIZE
 
 RECORD_SHAPE = (PACKED_CHANNELS, BOARD_SIZE, BOARD_SIZE)
@@ -110,9 +112,19 @@ class GoDataset:
         return self._winner_positions
 
     def batch_at(self, indices: np.ndarray):
-        """Gather (packed_planes, to_move_player, rank_of_player, target)."""
-        packed = self.planes[indices]  # (B, 9, 19, 19) uint8 copy
-        meta = self.meta[indices]
+        """Gather (packed_planes, to_move_player, rank_of_player, target).
+
+        The memmap gather is the one spot where shared-storage flakiness
+        (EIO on a cold page, the loader_io fault point) reaches training,
+        so it runs under the bounded-backoff retry policy: transient
+        OSErrors are absorbed with a logged note, anything persistent
+        propagates after the attempts run out."""
+        def gather():
+            faults.check("loader_io")
+            return self.planes[indices], self.meta[indices]
+
+        # (B, 9, 19, 19) uint8 copy out of the memmap
+        packed, meta = retry_with_backoff(gather, attempts=5, base_delay=0.05)
         player = meta[:, M_PLAYER]
         rank = np.where(player == 1, meta[:, M_BLACK_RANK], meta[:, M_WHITE_RANK])
         target = meta[:, M_X] * BOARD_SIZE + meta[:, M_Y]
@@ -188,6 +200,11 @@ class DatasetWriter:
         self._count += m
 
     def finalize(self) -> int:
+        # durable before visible, same contract as utils.atomicio: a crash
+        # during transcription must never leave a plausible-looking but
+        # partially-flushed planes.bin under the final name
+        self._planes_f.flush()
+        os.fsync(self._planes_f.fileno())
         self._planes_f.close()
         os.replace(os.path.join(self.out_dir, "planes.bin.tmp"),
                    os.path.join(self.out_dir, "planes.bin"))

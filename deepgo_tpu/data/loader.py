@@ -31,6 +31,51 @@ class LoaderClosed(RuntimeError):
     """get()/_drain called on (or blocked in) a closed AsyncLoader."""
 
 
+def step_rng(seed: int, step: int) -> np.random.Generator:
+    """The generator for training step ``step``: a pure function of
+    (seed, step), independent of loader history. This is what makes the
+    synchronous data stream *restartable* — a resume at step t draws
+    exactly the batches the uninterrupted run would have drawn — and
+    *scan-depth-invariant* (a K-step superbatch contains bitwise the same
+    per-step batches as K single-step gets)."""
+    return np.random.default_rng(np.random.SeedSequence((seed, step)))
+
+
+def make_step_batch(dataset: GoDataset, seed: int, step: int, batch_size: int,
+                    scheme: str = "game", augment: bool = False,
+                    wire: str = "packed", stack: int = 0) -> dict:
+    """Deterministic (super)batch covering steps [step, step + max(1, stack)).
+
+    Each covered step samples from its own ``step_rng``; the gather and the
+    optional nibble pass still run once over all k*B positions (the
+    superbatch economics of make_host_superbatch). ``stack=0`` returns a
+    flat (B, ...) batch, ``stack>=1`` a (K, B, ...) superbatch."""
+    k = max(1, stack)
+    idx_parts, sym_parts = [], []
+    for t in range(step, step + k):
+        rng = step_rng(seed, t)
+        idx_parts.append(dataset.sample_indices(rng, batch_size, scheme))
+        if augment:
+            sym_parts.append(rng.integers(0, 8, size=batch_size).astype(np.int32))
+    packed, player, rank, target = dataset.batch_at(np.concatenate(idx_parts))
+    if wire == "nibble":
+        from ..ops.wire import nibble_pack_np
+
+        packed = nibble_pack_np(packed)
+
+    def fold(a: np.ndarray) -> np.ndarray:
+        if stack < 1:
+            return a
+        return a.reshape(k, batch_size, *a.shape[1:])
+
+    batch = {"packed": fold(packed), "player": fold(player),
+             "rank": fold(rank), "target": fold(target)}
+    if augment:
+        sym = np.concatenate(sym_parts)
+        batch["sym"] = fold(sym)
+    return batch
+
+
 def make_host_batch(dataset: GoDataset, rng: np.random.Generator, batch_size: int,
                     scheme: str = "game", augment: bool = False,
                     wire: str = "packed") -> dict:
@@ -89,6 +134,7 @@ class AsyncLoader:
         batch_size: int,
         scheme: str = "game",
         seed: int = 0,
+        start_step: int = 0,
         num_threads: int = 2,
         prefetch: int = 4,
         sharding=None,
@@ -111,7 +157,16 @@ class AsyncLoader:
         thread that assembles and ``device_put``s up to N (super)batches
         ahead, so the transfer of batch n+1 runs while the device computes
         batch n even when ``device_put`` itself blocks (as it does through
-        the relay tunnel)."""
+        the relay tunnel).
+
+        ``start_step`` is the training step this loader begins feeding.
+        With ``num_threads=0`` the stream is *step-indexed*: batch for
+        step t is a pure function of (seed, t) via ``step_rng``, so a
+        resumed run replays the uninterrupted stream bit-exactly
+        (docs/robustness.md). Threaded mode keeps the free-running i.i.d.
+        stream (thread scheduling already makes its order nondeterministic;
+        there start_step only offsets the worker seeds, continuing the
+        stream statistically rather than bitwise)."""
         self.dataset = dataset
         self.batch_size = batch_size
         self.scheme = scheme
@@ -139,7 +194,9 @@ class AsyncLoader:
                                            P(None, *sharding.spec))
         self.stack_sharding = stack_sharding
         self.num_threads = num_threads
-        self._seq = np.random.SeedSequence(seed)
+        self._seed = seed
+        self._cursor = start_step  # next step to feed (step-indexed mode)
+        self._seq = np.random.SeedSequence(seed + start_step)
         self._worker_error: BaseException | None = None
         self._dev_queue: queue.Queue | None = None
         if num_threads > 0:
@@ -170,12 +227,18 @@ class AsyncLoader:
                 self._threads.append(self._uploader)
                 self._uploader.start()
         else:
-            self._rng = np.random.default_rng(self._seq)
-            self._sync_rng = self._rng
+            self._sync_rng = None  # sync mode is step-indexed, rng-free
 
-    def _produce(self, stack: int, rng: np.random.Generator) -> dict:
+    def _produce(self, stack: int, rng: np.random.Generator | None) -> dict:
         """Sample one unit at the given depth: a (B, ...) batch when
-        ``stack < 1``, a (K, B, ...) superbatch otherwise."""
+        ``stack < 1``, a (K, B, ...) superbatch otherwise. ``rng=None``
+        (sync mode) draws step-indexed from the loader's step cursor."""
+        if rng is None:
+            batch = make_step_batch(self.dataset, self._seed, self._cursor,
+                                    self.batch_size, self.scheme,
+                                    self.augment, self.wire, stack=stack)
+            self._cursor += max(1, stack)
+            return batch
         if stack < 1:
             return make_host_batch(self.dataset, rng, self.batch_size,
                                    self.scheme, self.augment, self.wire)

@@ -1,4 +1,4 @@
-"""Checkpoint save/resume in a self-describing single-file format.
+"""Crash-safe checkpointing in a self-describing single-file format.
 
 Same semantics as the reference — one artifact holding config, weights,
 optimizer state, iteration count, and validation history, auto-saved at
@@ -12,16 +12,124 @@ Pytrees are stored as ordered flat leaves (params_000, params_001, ...,
 opt_000, ...) and rebuilt by unflattening into a template generated from the
 stored config, which keeps the format independent of private treedef
 serialization details.
+
+Format v2 adds the crash-safety layer (docs/robustness.md):
+
+  * every write is atomic (temp file + fsync + os.replace via
+    utils.atomicio), so a preemption mid-save can never tear the only
+    recovery artifact;
+  * the JSON meta carries an ``integrity`` block — a CRC32 per stored
+    array plus a SHA-256 digest over all array payloads — verified on
+    load, so bit rot and torn copies are detected instead of silently
+    training from garbage;
+  * run directories hold rolling ``checkpoint-{step:08d}.npz`` files and
+    ``find_latest_valid`` picks the newest one that passes verification,
+    skipping corrupt candidates with a logged reason (elastic
+    auto-resume).
+
+v1 files (no integrity block) still load; they just can't be verified.
+All validation failures raise :class:`CheckpointError` (never ``assert``,
+which vanishes under ``python -O``) carrying the offending path.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
+import re
+import sys
+import zipfile
+import zlib
 
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+from ..utils import faults
+from ..utils.atomicio import atomic_write
+
+FORMAT_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
+
+_CKPT_RE = re.compile(r"^checkpoint-(\d+)\.npz$")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint that cannot be trusted: missing, truncated, corrupt,
+    from an unknown format, or shaped for a different model. Carries the
+    path and a reason; ``find_latest_valid`` treats it as "skip this file",
+    direct loads surface it to the caller."""
+
+    def __init__(self, path: str, reason: str):
+        self.path = path
+        self.reason = reason
+        super().__init__(f"checkpoint {path}: {reason}")
+
+
+def checkpoint_name(step: int) -> str:
+    """Rolling per-step artifact name; zero-padded so lexicographic and
+    numeric order agree for any run shorter than 10^8 steps."""
+    return f"checkpoint-{step:08d}.npz"
+
+
+# ---- integrity ----
+
+
+def _leaf_bytes(arr: np.ndarray) -> bytes:
+    return np.ascontiguousarray(arr).tobytes()
+
+
+def _integrity(arrays: dict) -> dict:
+    """Per-array CRC32s plus a whole-checkpoint SHA-256 over every array
+    payload (sorted key order), stored in the JSON meta. The zip layer has
+    its own member CRCs, but those only protect the compressed container —
+    this block survives format migrations and catches e.g. a truncated
+    copy of an uncompressed member."""
+    crcs = {}
+    digest = hashlib.sha256()
+    for key in sorted(arrays):
+        data = _leaf_bytes(arrays[key])
+        crcs[key] = zlib.crc32(data)
+        digest.update(key.encode())
+        digest.update(str(arrays[key].dtype).encode())
+        digest.update(repr(tuple(arrays[key].shape)).encode())
+        digest.update(data)
+    return {"arrays": crcs, "digest": digest.hexdigest()}
+
+
+def _verify_integrity(path: str, meta: dict, arrays: dict) -> None:
+    if meta.get("format_version", 1) < 2:
+        return  # v1 predates the integrity block: loadable, unverifiable
+    integ = meta.get("integrity")
+    if not isinstance(integ, dict) or "arrays" not in integ:
+        raise CheckpointError(
+            path, "format v2 without an integrity block in meta "
+                  "(truncated meta, or written by a broken tool)")
+    expected = integ["arrays"]
+    if set(expected) != set(arrays):
+        missing = sorted(set(expected) - set(arrays))
+        extra = sorted(set(arrays) - set(expected))
+        raise CheckpointError(
+            path, f"array set mismatch vs meta (missing {missing}, "
+                  f"unexpected {extra}) — partial or spliced file")
+    digest = hashlib.sha256()
+    for key in sorted(arrays):
+        data = _leaf_bytes(arrays[key])
+        if zlib.crc32(data) != expected[key]:
+            raise CheckpointError(
+                path, f"CRC32 mismatch for array {key!r} — bit corruption; "
+                      f"delete this file (auto-resume skips it automatically)")
+        digest.update(key.encode())
+        digest.update(str(arrays[key].dtype).encode())
+        digest.update(repr(tuple(arrays[key].shape)).encode())
+        digest.update(data)
+    if digest.hexdigest() != integ.get("digest"):
+        raise CheckpointError(
+            path, "whole-file digest mismatch — bit corruption; delete this "
+                  "file (auto-resume skips it automatically)")
+
+
+# ---- save / load ----
 
 
 def save_checkpoint(path: str, params, opt_state, meta: dict) -> None:
@@ -32,23 +140,77 @@ def save_checkpoint(path: str, params, opt_state, meta: dict) -> None:
         arrays[f"params_{i:04d}"] = np.asarray(leaf)
     for i, leaf in enumerate(o_leaves):
         arrays[f"opt_{i:04d}"] = np.asarray(leaf)
-    arrays["meta"] = np.frombuffer(
-        json.dumps({"format_version": FORMAT_VERSION, **meta}).encode(), dtype=np.uint8
-    )
-    with open(path, "wb") as f:
+    meta_json = json.dumps({
+        "format_version": FORMAT_VERSION,
+        "integrity": _integrity(arrays),
+        **meta,
+    })
+    arrays["meta"] = np.frombuffer(meta_json.encode(), dtype=np.uint8)
+    # atomic: a crash (or injected ckpt_write fault) anywhere in here leaves
+    # the previous checkpoint intact and at most a stray .tmp that
+    # find_latest_valid never considers
+    with atomic_write(path) as f:
+        faults.check("ckpt_write")
         np.savez(f, **arrays)
 
 
-def load_checkpoint(path: str):
-    """Returns (meta dict, params_leaves list, opt_leaves list)."""
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
+def _open_npz(path: str):
+    try:
+        size = os.path.getsize(path)
+    except OSError as e:
+        raise CheckpointError(path, f"unreadable: {e}") from e
+    if size == 0:
+        raise CheckpointError(
+            path, "zero-length file — crash before any bytes were written")
+    try:
+        return np.load(path, allow_pickle=False)
+    except (zipfile.BadZipFile, ValueError, OSError, EOFError) as e:
+        raise CheckpointError(
+            path, f"not a readable npz ({e}) — truncated or corrupt") from e
+
+
+def _read_meta(z, path: str) -> dict:
+    if "meta" not in z.files:
+        raise CheckpointError(
+            path, "no meta entry — not a deepgo checkpoint, or the write "
+                  "was torn before the meta member landed")
+    try:
+        meta = json.loads(bytes(_read_member(z, "meta", path)).decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CheckpointError(path, f"meta entry is not valid JSON: {e}") from e
+    version = meta.get("format_version")
+    if version not in SUPPORTED_VERSIONS:
+        raise CheckpointError(
+            path, f"format_version {version!r} not in supported "
+                  f"{SUPPORTED_VERSIONS} — written by an incompatible "
+                  f"deepgo_tpu; re-save or upgrade")
+    return meta
+
+
+def _read_member(z, key: str, path: str) -> np.ndarray:
+    """npz members decompress lazily; a flipped byte or truncated tail
+    surfaces here as a zip/zlib error, not at np.load time."""
+    try:
+        return z[key]
+    except (zipfile.BadZipFile, zlib.error, ValueError, OSError, EOFError) as e:
+        raise CheckpointError(
+            path, f"array {key!r} unreadable ({e}) — truncated or corrupt") from e
+
+
+def load_checkpoint(path: str, verify: bool = True):
+    """Returns (meta dict, params_leaves list, opt_leaves list).
+
+    ``verify=True`` (the default) checks every array against the meta's
+    CRC32s and the whole-file digest; pass False only when re-reading a
+    file already verified this process."""
+    with _open_npz(path) as z:
+        meta = _read_meta(z, path)
         p_keys = sorted(k for k in z.files if k.startswith("params_"))
         o_keys = sorted(k for k in z.files if k.startswith("opt_"))
-        params_leaves = [z[k] for k in p_keys]
-        opt_leaves = [z[k] for k in o_keys]
-    assert meta.get("format_version") == FORMAT_VERSION, meta.get("format_version")
-    return meta, params_leaves, opt_leaves
+        arrays = {k: _read_member(z, k, path) for k in (*p_keys, *o_keys)}
+    if verify:
+        _verify_integrity(path, meta, arrays)
+    return meta, [arrays[k] for k in p_keys], [arrays[k] for k in o_keys]
 
 
 def load_meta(path: str) -> dict:
@@ -56,21 +218,75 @@ def load_meta(path: str) -> dict:
     npz members load lazily, so this skips the weight arrays entirely.
     Lets tools plot or inspect runs straight from a checkpoint (reference
     plot.lua:5-29 plots from .model files the same way)."""
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-    assert meta.get("format_version") == FORMAT_VERSION, meta.get("format_version")
+    with _open_npz(path) as z:
+        return _read_meta(z, path)
+
+
+def verify_checkpoint(path: str) -> dict:
+    """Full integrity pass (structure, meta, per-array CRCs, digest).
+    Returns the meta on success, raises CheckpointError otherwise."""
+    meta, _, _ = load_checkpoint(path, verify=True)
     return meta
 
 
-def unflatten_like(template, leaves):
+def unflatten_like(template, leaves, path: str = "<checkpoint>"):
     """Rebuild a pytree with ``template``'s structure from flat ``leaves``."""
     treedef = jax.tree.structure(template)
-    assert treedef.num_leaves == len(leaves), (
-        f"checkpoint has {len(leaves)} leaves, template needs {treedef.num_leaves}"
-    )
+    if treedef.num_leaves != len(leaves):
+        raise CheckpointError(
+            path, f"has {len(leaves)} leaves, template needs "
+                  f"{treedef.num_leaves} — checkpoint config and model "
+                  f"architecture disagree")
     t_leaves = jax.tree.leaves(template)
     for i, (a, b) in enumerate(zip(t_leaves, leaves)):
-        assert tuple(a.shape) == tuple(b.shape), (
-            f"leaf {i}: checkpoint shape {b.shape} != template {a.shape}"
-        )
+        if tuple(a.shape) != tuple(b.shape):
+            raise CheckpointError(
+                path, f"leaf {i}: checkpoint shape {tuple(b.shape)} != "
+                      f"template {tuple(a.shape)} — checkpoint config and "
+                      f"model architecture disagree")
     return jax.tree.unflatten(treedef, leaves)
+
+
+# ---- run-directory scanning (elastic auto-resume) ----
+
+
+def list_checkpoints(run_dir: str) -> list[tuple[int, str]]:
+    """(step, path) for every rolling checkpoint in ``run_dir``, ascending
+    by step. Temp files, the legacy single ``checkpoint.npz``, and the
+    convenience alias are not included."""
+    try:
+        names = os.listdir(run_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    out = []
+    for name in names:
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(run_dir, name)))
+    out.sort()
+    return out
+
+
+def find_latest_valid(run_dir: str, log=None) -> str | None:
+    """Newest checkpoint in ``run_dir`` that passes full verification.
+
+    Scans rolling ``checkpoint-{step:08d}.npz`` files newest-first, then a
+    legacy plain ``checkpoint.npz`` (unless it's just the alias symlink to
+    a rolling file already scanned). Truncated / corrupt / partial
+    candidates are skipped with a logged reason rather than aborting the
+    resume — the whole point is surviving a kill that landed mid-write.
+    Returns None when nothing valid exists (callers start fresh)."""
+    if log is None:
+        def log(msg):
+            print(msg, file=sys.stderr, flush=True)
+    candidates = [p for _, p in reversed(list_checkpoints(run_dir))]
+    legacy = os.path.join(run_dir, "checkpoint.npz")
+    if os.path.lexists(legacy) and not os.path.islink(legacy):
+        candidates.append(legacy)
+    for path in candidates:
+        try:
+            verify_checkpoint(path)
+            return path
+        except CheckpointError as e:
+            log(f"auto-resume: skipping {e.path}: {e.reason}")
+    return None

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 import time
 import uuid
 from dataclasses import dataclass
@@ -35,6 +36,9 @@ from ..parallel import data_sharding, make_mesh, replicated_sharding
 from ..training import make_eval_step, make_train_step, make_train_step_many
 from ..training.optimizers import OPTIMIZERS
 from ..utils import MetricsWriter, append_registry, git_sha
+from ..utils import faults
+from ..utils.atomicio import atomic_write
+from ..utils.retry import retry_with_backoff
 from . import checkpoint as ckpt
 
 
@@ -105,6 +109,14 @@ class ExperimentConfig:
     seed: int = 0
     run_dir: str = "runs"
     profile: bool = False  # capture a jax.profiler trace of train() into the run dir
+    # robustness (docs/robustness.md): rolling retention keeps the newest
+    # N checkpoint-{step}.npz files plus the best-validation one (0 = keep
+    # everything); ``faults`` installs a fault-injection plan in the
+    # DEEPGO_FAULTS grammar (the env var wins when both are set — and note
+    # a config-driven kill re-arms on resume, since the config rides in
+    # the checkpoint; prefer the env var for kill testing)
+    keep_checkpoints: int = 3
+    faults: str = ""
 
     def model_config(self) -> policy_cnn.ModelConfig:
         channels = self.channels
@@ -140,6 +152,10 @@ class Experiment:
         self.id = run_id or uuid.uuid4().hex[:8]
         self.step = 0
         self.validation_history: list[dict] = []
+        # EWMA training cost rides in checkpoints so a resumed run's loss
+        # curve continues bit-exactly instead of re-warming from scratch
+        self.ewma: float | None = None
+        self.last_loss: float = float("nan")
         self.initialized = False
         self.params = None
         self.opt_state = None
@@ -148,6 +164,8 @@ class Experiment:
 
     def init(self) -> None:
         cfg = self.config
+        if cfg.faults and not os.environ.get("DEEPGO_FAULTS"):
+            faults.install(cfg.faults)
         n_devices = len(jax.devices())
         dp = cfg.data_parallel or max(1, n_devices // cfg.tensor_parallel)
         assert cfg.batch_size % dp == 0, (
@@ -288,8 +306,10 @@ class Experiment:
             print(f"warning: steps_per_call={k_steps} on the CPU backend "
                   "runs the scanned train step, which XLA CPU executes "
                   "~100x slower than steps_per_call=1", flush=True)
-        ewma = None
-        last_loss = float("nan")
+        # a resume picks the EWMA up from the checkpoint, so the folded
+        # sequence of loss updates is identical to an uninterrupted run's
+        ewma = self.ewma
+        last_loss = self.last_loss
         last_val: dict = {}
         pending: list = []  # device-resident per-call loss vectors
 
@@ -301,13 +321,18 @@ class Experiment:
                     ewma = value if ewma is None else 0.95 * ewma + 0.05 * value
                     last_loss = value
             pending.clear()
+            self.ewma, self.last_loss = ewma, last_loss
             return ewma, last_loss
         window_t0 = total_t0 = time.time()
         with AsyncLoader(
             train_set,
             cfg.batch_size,
             scheme=cfg.scheme,
-            seed=cfg.seed + self.step,  # resume continues the stream, not repeats it
+            # sync mode: the stream is a pure function of (seed, step), so
+            # a resume replays the uninterrupted run bit-exactly; threaded
+            # mode continues the stream statistically (loader.py docstring)
+            seed=cfg.seed,
+            start_step=self.step,
             num_threads=cfg.loader_threads,
             prefetch=cfg.prefetch,
             sharding=self.batch_sharding,
@@ -332,12 +357,19 @@ class Experiment:
                     # debugging (reference train.lua:106-109 kept it in
                     # globals; a file survives the process). Full-window
                     # superbatches carry the leading (K, B) step dimension.
+                    # Atomic so a crash while dumping can't tear an earlier
+                    # capture — the postmortem artifact deserves the same
+                    # guarantee as the checkpoint.
                     bad = {k_: np.asarray(v) for k_, v in batch.items()}
-                    np.savez(os.path.join(self.run_path, "bad_batch.npz"), **bad)
+                    with atomic_write(
+                        os.path.join(self.run_path, "bad_batch.npz")
+                    ) as f:
+                        np.savez(f, **bad)
 
                 if k == k_steps and use_scan:
                     batch = loader.get()
                     try:
+                        faults.check("train_step")
                         self.params, self.opt_state, losses = step_many(
                             self.params, self.opt_state, batch
                         )
@@ -348,6 +380,7 @@ class Experiment:
                     self.step += k
                     remaining -= k
                     window_steps += k
+                    faults.check("kill", step=self.step)
                 else:
                     # alignment / tail remainders run through the
                     # single-step program (already compiled) instead of
@@ -357,6 +390,7 @@ class Experiment:
                     for _ in range(k):
                         batch = loader.get(stack=0)
                         try:
+                            faults.check("train_step")
                             self.params, self.opt_state, loss = self.train_step(
                                 self.params, self.opt_state, batch
                             )
@@ -367,6 +401,7 @@ class Experiment:
                         self.step += 1
                         remaining -= 1
                         window_steps += 1
+                        faults.check("kill", step=self.step)
                 # losses stay on device between prints so calls dispatch
                 # asynchronously; fetching every call would serialize the
                 # loop on the host<->device round-trip
@@ -381,7 +416,7 @@ class Experiment:
                     if self.step % cfg.validation_interval == 0:
                         last_val = self.validate(val_batches)
                         metrics.write("validation", step=self.step, **last_val)
-                        self.save()
+                        self._save_periodic()
                         print(f"validation at iteration {self.step}: "
                               f"cost={last_val['cost']:.4f}, "
                               f"accuracy={last_val['accuracy']:.4f}")
@@ -483,16 +518,81 @@ class Experiment:
     # ---- checkpointing ----
 
     def save(self, path: str | None = None) -> str:
-        path = path or os.path.join(self.run_path, "checkpoint.npz")
+        """Write one atomic, integrity-checked checkpoint.
+
+        With no explicit ``path`` the run directory gets a rolling
+        ``checkpoint-{step:08d}.npz``, the ``checkpoint.npz`` convenience
+        alias is refreshed, and retention prunes old files (keep-last-N
+        plus the best-validation step, ``config.keep_checkpoints``)."""
+        managed = path is None
+        path = path or os.path.join(self.run_path,
+                                    ckpt.checkpoint_name(self.step))
         meta = {
             "id": self.id,
             "step": self.step,
             "validation_history": self.validation_history,
+            "ewma": self.ewma,
+            "last_loss": self.last_loss,
             "config": self.config.to_dict(),
             "git_sha": git_sha(),
         }
         ckpt.save_checkpoint(path, self.params, self.opt_state, meta)
+        if managed:
+            self._refresh_latest_alias(path)
+            self._apply_retention()
         return path
+
+    def _save_periodic(self) -> str | None:
+        """The in-loop save: transient I/O faults are retried, and a save
+        that still fails is logged and *survived* — losing one periodic
+        checkpoint must not kill a healthy training run (the previous
+        rolling checkpoint is still on disk and still valid)."""
+        try:
+            return retry_with_backoff(self.save, attempts=3, base_delay=0.1)
+        except (OSError, RuntimeError) as e:
+            print(f"warning: checkpoint save failed at step {self.step} "
+                  f"({e}); training continues on the previous checkpoint",
+                  file=sys.stderr, flush=True)
+            return None
+
+    def _refresh_latest_alias(self, path: str) -> None:
+        """Best-effort ``checkpoint.npz`` symlink to the newest rolling
+        checkpoint, keeping the documented single-file path working. A
+        pre-rolling *real* checkpoint.npz is left alone (it's a valid
+        artifact, and find_latest_valid still considers it)."""
+        alias = os.path.join(self.run_path, "checkpoint.npz")
+        if os.path.lexists(alias) and not os.path.islink(alias):
+            return
+        tmp = alias + ".lnk"
+        try:
+            if os.path.lexists(tmp):
+                os.unlink(tmp)
+            os.symlink(os.path.basename(path), tmp)
+            os.replace(tmp, alias)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _apply_retention(self) -> None:
+        """Prune rolling checkpoints to the newest ``keep_checkpoints``
+        plus the best-validation step (lowest cost); 0 keeps everything."""
+        keep = self.config.keep_checkpoints
+        if keep <= 0:
+            return
+        entries = ckpt.list_checkpoints(self.run_path)
+        keep_steps = {s for s, _ in entries[-keep:]}
+        finite = [r for r in self.validation_history
+                  if np.isfinite(r.get("cost", float("nan")))]
+        if finite:
+            keep_steps.add(min(finite, key=lambda r: r["cost"])["step"])
+        for s, p in entries:
+            if s not in keep_steps:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
 
     @classmethod
     def load(cls, path: str) -> "Experiment":
@@ -503,13 +603,39 @@ class Experiment:
         exp = cls(config, run_id=meta["id"])
         exp.step = meta["step"]
         exp.validation_history = list(meta["validation_history"])
+        exp.ewma = meta.get("ewma")
+        last_loss = meta.get("last_loss")
+        exp.last_loss = float("nan") if last_loss is None else last_loss
         exp.init()
         exp.params = jax.device_put(
-            ckpt.unflatten_like(exp.params, p_leaves),
+            ckpt.unflatten_like(exp.params, p_leaves, path),
             replicated_sharding(exp.mesh),
         )
         exp.opt_state = jax.device_put(
-            ckpt.unflatten_like(exp.opt_state, o_leaves),
+            ckpt.unflatten_like(exp.opt_state, o_leaves, path),
             replicated_sharding(exp.mesh),
         )
         return exp
+
+    @classmethod
+    def auto_resume(cls, run_dir: str, overrides: dict | None = None,
+                    log=None) -> "Experiment":
+        """Elastic resume: continue from the newest *valid* checkpoint in
+        ``run_dir`` (corrupt/truncated candidates are skipped with a
+        logged reason), or start a fresh run rooted at exactly that
+        directory when none exists — so one retry loop of
+        ``cli train --auto-resume <run_dir>`` survives any number of
+        kills. On resume the stored config wins over ``overrides``: the
+        bit-exact continuation guarantee is only meaningful against the
+        configuration the run actually started with."""
+        path = ckpt.find_latest_valid(run_dir, log=log)
+        if path is not None:
+            if overrides:
+                print(f"auto-resume: ignoring overrides {sorted(overrides)} "
+                      f"(config comes from {path})", file=sys.stderr)
+            return cls.load(path)
+        run_dir = run_dir.rstrip("/")
+        parent, run_id = os.path.split(run_dir)
+        config = ExperimentConfig(**(overrides or {}))
+        config = config.replace(run_dir=parent or ".")
+        return cls(config, run_id=run_id or None)

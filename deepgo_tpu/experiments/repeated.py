@@ -37,7 +37,8 @@ def warm_restart(path: str, overrides: dict, num: int = 0) -> Experiment:
     exp.validation_history = list(meta["validation_history"])
     exp.init()  # fresh optimizer state: reference repeated.lua:17
     exp.params = jax.device_put(
-        ckpt.unflatten_like(exp.params, p_leaves), replicated_sharding(exp.mesh)
+        ckpt.unflatten_like(exp.params, p_leaves, path),
+        replicated_sharding(exp.mesh),
     )
     return exp
 

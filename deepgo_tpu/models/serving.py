@@ -91,7 +91,8 @@ def load_policy(checkpoint_path: str, top_k: int = 5):
     config = ExperimentConfig.from_dict(meta["config"])
     cfg = config.model_config()
     template = policy_cnn.init(jax.random.key(0), cfg)
-    params = ckpt.unflatten_like(template, [jnp.asarray(x) for x in p_leaves])
+    params = ckpt.unflatten_like(
+        template, [jnp.asarray(x) for x in p_leaves], checkpoint_path)
     return make_policy_fn(cfg, top_k=top_k), params, cfg
 
 
@@ -122,5 +123,6 @@ def load_value(checkpoint_path: str):
         f"{checkpoint_path} is not a value checkpoint: {meta.get('kind')!r}")
     cfg = value_cnn.ValueConfig(**meta["config"])
     template = value_cnn.init(jax.random.key(0), cfg)
-    params = ckpt.unflatten_like(template, [jnp.asarray(x) for x in p_leaves])
+    params = ckpt.unflatten_like(
+        template, [jnp.asarray(x) for x in p_leaves], checkpoint_path)
     return make_value_fn(cfg), params, cfg

@@ -1,9 +1,11 @@
-"""Utilities: metrics, timing, run identity."""
+"""Utilities: metrics, timing, run identity, crash safety."""
 
 import os
 
 from .metrics import MetricsWriter, append_registry  # noqa: F401
 from .gitinfo import git_sha  # noqa: F401
+from .atomicio import atomic_write, atomic_write_bytes  # noqa: F401
+from .retry import retry_with_backoff  # noqa: F401
 
 
 def honor_platform_env() -> None:

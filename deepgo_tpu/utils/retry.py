@@ -1,0 +1,56 @@
+"""Bounded retry with exponential backoff for transient I/O faults.
+
+Cluster-scale training treats transient filesystem and loader hiccups
+(NFS timeouts, preemption-adjacent EIO, the relay tunnel dropping a read)
+as absorbable noise: retry a few times with growing sleeps, then give up
+loudly. The policy is deliberately bounded — unbounded retries turn a hard
+fault into a silent hang, which is worse than the crash (the watchdog and
+the auto-resume path both prefer a dead process to a wedged one).
+
+Only exceptions in ``retry_on`` (default: ``OSError``) are retried; any
+other exception is a logic error and propagates immediately.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def retry_with_backoff(
+    fn,
+    *,
+    attempts: int = 5,
+    base_delay: float = 0.05,
+    factor: float = 2.0,
+    max_delay: float = 2.0,
+    retry_on: tuple = (OSError,),
+    on_retry=None,
+    sleep=time.sleep,
+):
+    """Call ``fn()``; retry ``retry_on`` failures up to ``attempts`` total
+    tries, sleeping ``base_delay * factor**k`` (capped at ``max_delay``)
+    between tries. The final failure re-raises. ``on_retry(exc, attempt,
+    delay)`` observes each absorbed failure (default: a stderr note, so
+    absorbed faults stay visible in run logs); ``sleep`` is injectable for
+    tests."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    delay = base_delay
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts:
+                raise
+            if on_retry is not None:
+                on_retry(e, attempt, delay)
+            else:
+                print(
+                    f"transient fault ({e}); retry {attempt}/{attempts - 1} "
+                    f"in {delay:.2f}s",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            sleep(delay)
+            delay = min(delay * factor, max_delay)

@@ -32,3 +32,12 @@ REFERENCE_DATA = "/root/reference/data"
 
 def reference_available() -> bool:
     return os.path.isdir(REFERENCE_DATA)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running integration tests (e.g. the subprocess "
+        "kill-and-resume path); tier-1 excludes them via -m 'not slow', "
+        "`make verify-faults` includes them",
+    )

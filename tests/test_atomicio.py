@@ -1,0 +1,54 @@
+"""Atomic write helper: all-or-nothing file replacement."""
+
+import os
+
+import pytest
+
+from deepgo_tpu.utils.atomicio import atomic_write, atomic_write_bytes
+
+
+def test_atomic_write_creates_and_replaces(tmp_path):
+    path = tmp_path / "f.bin"
+    with atomic_write(str(path)) as f:
+        f.write(b"one")
+    assert path.read_bytes() == b"one"
+    with atomic_write(str(path)) as f:
+        f.write(b"two")
+    assert path.read_bytes() == b"two"
+    # no temp residue either way
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["f.bin"]
+
+
+def test_atomic_write_failure_preserves_original(tmp_path):
+    path = tmp_path / "f.bin"
+    path.write_bytes(b"precious")
+    with pytest.raises(RuntimeError, match="mid-write"):
+        with atomic_write(str(path)) as f:
+            f.write(b"partial garbage")
+            raise RuntimeError("crash mid-write")
+    # the original is untouched and the partial temp file is gone
+    assert path.read_bytes() == b"precious"
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["f.bin"]
+
+
+def test_atomic_write_failure_leaves_no_file_when_new(tmp_path):
+    path = tmp_path / "new.bin"
+    with pytest.raises(ValueError):
+        with atomic_write(str(path)) as f:
+            f.write(b"x")
+            raise ValueError("boom")
+    assert not path.exists()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_atomic_write_text_mode(tmp_path):
+    path = tmp_path / "t.txt"
+    with atomic_write(str(path), "w") as f:
+        f.write("hello")
+    assert path.read_text() == "hello"
+
+
+def test_atomic_write_bytes(tmp_path):
+    path = tmp_path / "b.bin"
+    atomic_write_bytes(str(path), b"\x00\x01")
+    assert path.read_bytes() == b"\x00\x01"

@@ -1,0 +1,190 @@
+"""Checkpoint format v2: integrity verification, corruption handling, and
+run-directory scanning for elastic auto-resume."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepgo_tpu.experiments import checkpoint as ckpt
+from deepgo_tpu.experiments.checkpoint import CheckpointError
+
+
+def write_ckpt(run_dir, step, value=0.0):
+    path = os.path.join(run_dir, ckpt.checkpoint_name(step))
+    ckpt.save_checkpoint(
+        path,
+        {"w": np.full(6, value, np.float32), "b": np.zeros(2, np.float32)},
+        {"m": np.zeros(3, np.float32)},
+        {"id": "t", "step": step, "validation_history": [], "config": {}},
+    )
+    return path
+
+
+# ---- format v2 round trip ----
+
+
+def test_v2_roundtrip_and_integrity_block(tmp_path):
+    path = write_ckpt(str(tmp_path), 7, value=1.5)
+    meta, p_leaves, o_leaves = ckpt.load_checkpoint(path)
+    assert meta["format_version"] == 2
+    assert meta["step"] == 7
+    np.testing.assert_array_equal(p_leaves[1], np.full(6, 1.5, np.float32))
+    assert len(o_leaves) == 1
+    # integrity: a CRC per stored array plus a whole-checkpoint digest
+    integ = meta["integrity"]
+    assert set(integ["arrays"]) == {"params_0000", "params_0001", "opt_0000"}
+    assert len(integ["digest"]) == 64  # sha256 hex
+
+
+def test_v1_checkpoint_still_loads(tmp_path):
+    # a pre-integrity artifact: loadable, just not verifiable
+    path = str(tmp_path / "old.npz")
+    meta = {"format_version": 1, "step": 3, "validation_history": [],
+            "config": {}, "id": "legacy"}
+    np.savez(path, params_0000=np.arange(4.0), opt_0000=np.zeros(2),
+             meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8))
+    got, p_leaves, _ = ckpt.load_checkpoint(path)
+    assert got["step"] == 3
+    assert ckpt.verify_checkpoint(path)["id"] == "legacy"
+
+
+def test_unsupported_version_raises_checkpoint_error(tmp_path):
+    path = str(tmp_path / "future.npz")
+    meta = {"format_version": 99}
+    np.savez(path, meta=np.frombuffer(json.dumps(meta).encode(), np.uint8))
+    with pytest.raises(CheckpointError, match="format_version 99"):
+        ckpt.load_meta(path)
+    with pytest.raises(CheckpointError, match="format_version 99"):
+        ckpt.load_checkpoint(path)
+
+
+def test_load_meta_skips_arrays_but_validates(tmp_path):
+    path = write_ckpt(str(tmp_path), 11)
+    assert ckpt.load_meta(path)["step"] == 11
+    with pytest.raises(CheckpointError):
+        ckpt.load_meta(str(tmp_path / "missing.npz"))
+
+
+# ---- unflatten validation ----
+
+
+def test_unflatten_like_leaf_count_mismatch(tmp_path):
+    template = {"a": np.zeros(2), "b": np.zeros(3)}
+    with pytest.raises(CheckpointError, match="1 leaves, template needs 2"):
+        ckpt.unflatten_like(template, [np.zeros(2)], "some.npz")
+
+
+def test_unflatten_like_shape_mismatch():
+    template = {"a": np.zeros(2)}
+    with pytest.raises(CheckpointError, match="shape"):
+        ckpt.unflatten_like(template, [np.zeros(5)])
+
+
+# ---- corruption matrix: every flavor yields a clean skip, not a traceback ----
+
+
+def corrupt_truncate(path):
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[: len(data) // 2])
+
+
+def corrupt_flip_byte(path):
+    # flip a byte inside the "w" array's payload (six float32 1.5s — the
+    # file midpoint can land in zip padding nothing ever reads)
+    data = bytearray(open(path, "rb").read())
+    payload = np.full(6, 1.5, np.float32).tobytes()
+    at = data.find(payload)
+    assert at > 0, "array payload not found uncompressed"
+    data[at] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+
+
+def corrupt_no_meta(path):
+    np.savez(path, params_0000=np.arange(4.0))
+
+
+def corrupt_zero_length(path):
+    open(path, "wb").close()
+
+
+@pytest.mark.parametrize("corrupt,reason", [
+    (corrupt_truncate, "truncated or corrupt"),
+    (corrupt_flip_byte, "corrupt|CRC"),  # zip CRC or our CRC, byte-dependent
+    (corrupt_no_meta, "no meta entry"),
+    (corrupt_zero_length, "zero-length"),
+])
+def test_verify_rejects_corruption(tmp_path, corrupt, reason):
+    path = write_ckpt(str(tmp_path), 5, value=1.5)
+    corrupt(path)
+    with pytest.raises(CheckpointError, match=reason) as ei:
+        ckpt.verify_checkpoint(path)
+    assert ei.value.path == path
+
+
+def test_our_crc_catches_what_zip_cannot(tmp_path):
+    # rewrite the npz with a bit-flipped array but *correct* zip metadata:
+    # only the meta-level CRC32/digest can catch this class of corruption
+    path = write_ckpt(str(tmp_path), 5)
+    with np.load(path) as z:
+        arrays = {k: z[k].copy() for k in z.files}
+    flipped = arrays["params_0000"].view(np.uint8).copy()
+    flipped[0] ^= 0x01
+    arrays["params_0000"] = flipped.view(np.float32)
+    np.savez(path, **arrays)  # fresh, internally-consistent zip
+    with pytest.raises(CheckpointError, match="CRC32 mismatch|digest"):
+        ckpt.verify_checkpoint(path)
+
+
+@pytest.mark.parametrize("corrupt", [
+    corrupt_truncate, corrupt_flip_byte, corrupt_no_meta, corrupt_zero_length,
+])
+def test_find_latest_valid_skips_corrupt_newest(tmp_path, corrupt):
+    run = str(tmp_path)
+    good = write_ckpt(run, 10)
+    bad = write_ckpt(run, 20, value=1.5)
+    corrupt(bad)
+    logged = []
+    assert ckpt.find_latest_valid(run, log=logged.append) == good
+    assert len(logged) == 1 and "skipping" in logged[0] and bad in logged[0]
+
+
+def test_find_latest_valid_logs_to_stderr_by_default(tmp_path, capsys):
+    run = str(tmp_path)
+    write_ckpt(run, 10)
+    corrupt_zero_length(write_ckpt(run, 20))
+    assert ckpt.find_latest_valid(run) is not None
+    assert "skipping" in capsys.readouterr().err
+
+
+def test_find_latest_valid_empty_and_missing_dir(tmp_path):
+    assert ckpt.find_latest_valid(str(tmp_path)) is None
+    assert ckpt.find_latest_valid(str(tmp_path / "nope")) is None
+
+
+def test_find_latest_valid_considers_legacy_single_file(tmp_path):
+    # an old-layout run directory: one plain checkpoint.npz, no rolling files
+    legacy = str(tmp_path / "checkpoint.npz")
+    ckpt.save_checkpoint(legacy, {"w": np.zeros(2)}, {"m": np.zeros(2)},
+                         {"id": "t", "step": 4, "validation_history": [],
+                          "config": {}})
+    assert ckpt.find_latest_valid(str(tmp_path)) == legacy
+
+
+def test_find_latest_valid_ignores_alias_symlink(tmp_path):
+    run = str(tmp_path)
+    newest = write_ckpt(run, 30)
+    os.symlink(os.path.basename(newest),
+               os.path.join(run, "checkpoint.npz"))
+    # the alias must not be scanned twice or shadow the numbered file
+    assert ckpt.find_latest_valid(run) == newest
+
+
+def test_list_checkpoints_orders_and_filters(tmp_path):
+    run = str(tmp_path)
+    write_ckpt(run, 20)
+    write_ckpt(run, 5)
+    open(os.path.join(run, "checkpoint-0000abcd.npz"), "w").close()  # not ours
+    open(os.path.join(run, "other.npz"), "w").close()
+    assert [s for s, _ in ckpt.list_checkpoints(run)] == [5, 20]

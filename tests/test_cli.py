@@ -31,3 +31,11 @@ def test_overrides_unknown_field_rejected():
 def test_overrides_bad_int_raises():
     with pytest.raises(ValueError):
         parse_overrides(["batch_size=many"])
+
+
+def test_resume_flags_mutually_exclusive():
+    from deepgo_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        main(["train", "--iters", "1",
+              "--resume", "x.npz", "--auto-resume", "rundir"])

@@ -1,0 +1,198 @@
+"""Fault-injection plan grammar, retry policy, and the fault points
+threaded through the loader and checkpoint paths."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepgo_tpu.data.dataset import GoDataset
+from deepgo_tpu.utils import faults
+from deepgo_tpu.utils.retry import retry_with_backoff
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Each test starts (and leaves) with no active plan and no env."""
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---- grammar ----
+
+
+def test_plan_parse_full_grammar():
+    plan = faults.FaultPlan.parse(
+        "ckpt_write:fail@2,loader_io:transient@5,kill:step@7")
+    assert [(s.site, s.kind, s.arg) for s in plan.specs] == [
+        ("ckpt_write", "fail", 2),
+        ("loader_io", "transient", 5),
+        ("kill", "step", 7),
+    ]
+    assert bool(plan)
+    assert not bool(faults.FaultPlan.parse(""))
+
+
+@pytest.mark.parametrize("bad", [
+    "ckpt_write",            # no kind
+    "ckpt_write:fail",       # no arg
+    "ckpt_write:explode@1",  # unknown kind
+    "ckpt_write:fail@x",     # non-integer arg
+    "ckpt_write:fail@0",     # arg must be >= 1
+    "ckpt_write:step@3",     # step@ is kill-only
+    "kill:fail@3",           # kill takes step@ only
+])
+def test_plan_parse_rejects_bad_specs(bad):
+    with pytest.raises(ValueError, match="bad fault spec"):
+        faults.FaultPlan.parse(bad)
+
+
+def test_plan_read_from_env(monkeypatch):
+    monkeypatch.setenv("DEEPGO_FAULTS", "loader_io:fail@1")
+    faults.reset()
+    with pytest.raises(faults.InjectedFailure):
+        faults.check("loader_io")
+
+
+# ---- semantics ----
+
+
+def test_fail_fires_on_nth_hit_only():
+    plan = faults.FaultPlan.parse("ckpt_write:fail@2")
+    plan.check("ckpt_write")  # hit 1 passes
+    with pytest.raises(faults.InjectedFailure):
+        plan.check("ckpt_write")  # hit 2 fires
+    plan.check("ckpt_write")  # hit 3 passes again (one-shot hard fault)
+    plan.check("other_site")  # unrelated sites never fire
+
+
+def test_transient_fires_first_n_hits():
+    plan = faults.FaultPlan.parse("loader_io:transient@2")
+    for _ in range(2):
+        with pytest.raises(faults.TransientFault):
+            plan.check("loader_io")
+    plan.check("loader_io")  # recovered
+    # transient faults are OSErrors so the production retry policy sees them
+    assert issubclass(faults.TransientFault, OSError)
+    assert not issubclass(faults.InjectedFailure, OSError)
+
+
+# ---- retry policy ----
+
+
+def test_retry_absorbs_transients_with_backoff():
+    delays = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_with_backoff(flaky, attempts=5, base_delay=0.05,
+                             on_retry=lambda e, a, d: None,
+                             sleep=delays.append)
+    assert out == "ok" and calls["n"] == 4
+    assert delays == [0.05, 0.1, 0.2]  # exponential
+
+
+def test_retry_exhaustion_reraises():
+    def always():
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        retry_with_backoff(always, attempts=3, base_delay=0.01,
+                           on_retry=lambda e, a, d: None, sleep=lambda d: None)
+
+
+def test_retry_does_not_catch_logic_errors():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise TypeError("bug, not weather")
+
+    with pytest.raises(TypeError):
+        retry_with_backoff(broken, attempts=5, sleep=lambda d: None)
+    assert calls["n"] == 1
+
+
+def test_retry_delay_capped():
+    delays = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 4:
+            raise OSError("x")
+        return 1
+
+    retry_with_backoff(flaky, attempts=5, base_delay=1.0, max_delay=2.0,
+                       on_retry=lambda e, a, d: None, sleep=delays.append)
+    assert delays == [1.0, 2.0, 2.0, 2.0]
+
+
+# ---- fault points in real paths ----
+
+
+def synth_dataset(root) -> GoDataset:
+    """A 16-position all-empty-board split, enough to exercise gathers."""
+    d = os.path.join(root, "train")
+    os.makedirs(d)
+    n = 16
+    np.zeros((n, 9, 19, 19), np.uint8).tofile(os.path.join(d, "planes.bin"))
+    meta = np.zeros((n, 6), np.int32)
+    meta[:, 0] = 1  # player
+    meta[:, 3] = meta[:, 4] = 1  # ranks
+    np.save(os.path.join(d, "meta.npy"), meta)
+    with open(os.path.join(d, "games.json"), "w") as f:
+        json.dump([{"name": "g", "start": 0, "count": n}], f)
+    return GoDataset(root, "train")
+
+
+def test_loader_io_transient_absorbed_by_batch_at(tmp_path, monkeypatch):
+    # cut the real sleeps out of the gather's retry policy
+    import deepgo_tpu.data.dataset as dataset_mod
+
+    real = dataset_mod.retry_with_backoff
+    monkeypatch.setattr(
+        dataset_mod, "retry_with_backoff",
+        lambda fn, **kw: real(fn, **{**kw, "sleep": lambda d: None,
+                                     "on_retry": lambda e, a, d: None}))
+    ds = synth_dataset(str(tmp_path))
+    faults.install("loader_io:transient@2")
+    packed, player, rank, target = ds.batch_at(np.arange(4))
+    assert packed.shape == (4, 9, 19, 19)  # two transients absorbed
+
+
+def test_loader_io_hard_fault_propagates(tmp_path):
+    ds = synth_dataset(str(tmp_path))
+    faults.install("loader_io:fail@1")
+    with pytest.raises(faults.InjectedFailure):
+        ds.batch_at(np.arange(4))
+    # one-shot: the next gather works
+    packed, *_ = ds.batch_at(np.arange(4))
+    assert packed.shape == (4, 9, 19, 19)
+
+
+def test_ckpt_write_fault_is_atomic(tmp_path):
+    from deepgo_tpu.experiments import checkpoint as ckpt
+
+    path = str(tmp_path / "checkpoint-00000005.npz")
+    ckpt.save_checkpoint(path, {"w": np.arange(4.0)}, {"m": np.zeros(2)},
+                         {"id": "x", "step": 5, "validation_history": [],
+                          "config": {}})
+    before = open(path, "rb").read()
+    faults.install("ckpt_write:fail@1")
+    with pytest.raises(faults.InjectedFailure):
+        ckpt.save_checkpoint(path, {"w": np.ones(4)}, {"m": np.ones(2)},
+                             {"id": "x", "step": 6,
+                              "validation_history": [], "config": {}})
+    # failed write left the previous artifact byte-identical, no temp files
+    assert open(path, "rb").read() == before
+    assert [p.name for p in tmp_path.iterdir()] == ["checkpoint-00000005.npz"]
+    assert ckpt.verify_checkpoint(path)["step"] == 5

@@ -1,0 +1,245 @@
+"""Crash-safe training: rolling retention, elastic auto-resume, bit-exact
+continuation, and the kill-and-resume integration path."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+from deepgo_tpu.data.transcribe import transcribe_split
+from deepgo_tpu.experiments import Experiment, ExperimentConfig
+from deepgo_tpu.experiments import checkpoint as ckpt
+from deepgo_tpu.utils import faults
+
+
+@pytest.fixture(scope="module")
+def data_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("processed")
+    for split in ("validation", "test"):
+        transcribe_split(
+            os.path.join(REPO_ROOT, "data/sgf", split),
+            str(root / split),
+            workers=1,
+            verbose=False,
+        )
+    return str(root)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def tiny_config(data_root, **kw):
+    defaults = dict(
+        name="resume-test",
+        num_layers=2,
+        channels=8,
+        batch_size=8,
+        rate=0.05,
+        validation_size=32,
+        validation_interval=10,
+        print_interval=10,
+        data_root=data_root,
+        train_split="validation",
+        validation_split="test",
+        test_split="test",
+        loader_threads=0,
+        data_parallel=1,
+    )
+    defaults.update(kw)
+    return ExperimentConfig(**defaults)
+
+
+def leaves(exp):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(exp.params)]
+
+
+def test_resume_is_bit_exact_vs_uninterrupted(data_root, tmp_path):
+    """The acceptance property behind auto-resume: save at step S, reload,
+    run the remaining steps, and land on bitwise the params — plus the
+    same EWMA and validation history — as one uninterrupted run. Holds
+    because the sync data stream is step-indexed (loader.step_rng) and the
+    EWMA rides in the checkpoint."""
+    full = Experiment(tiny_config(data_root, run_dir=str(tmp_path / "a")))
+    s_full = full.run(30)
+
+    part = Experiment(tiny_config(data_root, run_dir=str(tmp_path / "b")))
+    part.run(12)
+    resumed = Experiment.load(part.save())
+    assert resumed.step == 12
+    assert resumed.ewma == part.ewma
+    s_res = resumed.run(18)
+
+    for a, b in zip(leaves(full), leaves(resumed)):
+        np.testing.assert_array_equal(a, b)
+    assert s_full["final_ewma"] == s_res["final_ewma"]
+    strip = [("step", "cost", "accuracy", "n")] * 2
+    assert (
+        [[v[k] for k in strip[0]] for v in full.validation_history]
+        == [[v[k] for k in strip[1]] for v in resumed.validation_history]
+    )
+
+
+def test_rolling_retention_keeps_last_n_and_best(data_root, tmp_path):
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"),
+                      validation_interval=5, print_interval=5,
+                      keep_checkpoints=2)
+    exp = Experiment(cfg)
+    exp.run(25)  # periodic checkpoints at 5, 10, 15, 20, 25
+    steps = [s for s, _ in ckpt.list_checkpoints(exp.run_path)]
+    best = min(
+        (v for v in exp.validation_history if np.isfinite(v["cost"])),
+        key=lambda v: v["cost"],
+    )["step"]
+    assert set(steps) == {20, 25} | {best}
+    # the alias tracks the newest rolling checkpoint
+    alias = os.path.join(exp.run_path, "checkpoint.npz")
+    assert os.path.islink(alias)
+    assert os.readlink(alias) == ckpt.checkpoint_name(25)
+    assert ckpt.verify_checkpoint(alias)["step"] == 25
+
+
+def test_keep_checkpoints_zero_keeps_everything(data_root, tmp_path):
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"),
+                      validation_interval=5, print_interval=5,
+                      keep_checkpoints=0)
+    exp = Experiment(cfg)
+    exp.run(15)
+    assert [s for s, _ in ckpt.list_checkpoints(exp.run_path)] == [5, 10, 15]
+
+
+def test_auto_resume_skips_corrupted_newest(data_root, tmp_path):
+    """Acceptance: a deliberately corrupted newest checkpoint is skipped in
+    favor of the previous valid one."""
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"),
+                      validation_interval=5, print_interval=5,
+                      keep_checkpoints=0)
+    exp = Experiment(cfg)
+    exp.run(10)  # checkpoints at 5 and 10
+    newest = os.path.join(exp.run_path, ckpt.checkpoint_name(10))
+    data = bytearray(open(newest, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(newest, "wb").write(bytes(data))
+
+    logged = []
+    resumed = Experiment.auto_resume(exp.run_path, log=logged.append)
+    assert resumed.step == 5
+    assert resumed.id == exp.id
+    assert any("skipping" in m and newest in m for m in logged)
+
+
+def test_auto_resume_fresh_when_no_checkpoint(data_root, tmp_path):
+    run_dir = str(tmp_path / "runs" / "trial7")
+    exp = Experiment.auto_resume(
+        run_dir, overrides=dict(tiny_config(data_root).to_dict()))
+    assert exp.step == 0
+    assert exp.id == "trial7"
+    exp.init()
+    assert exp.run_path == run_dir
+
+
+def test_periodic_save_survives_injected_write_fault(data_root, tmp_path,
+                                                     capsys):
+    """A hard fault in the periodic checkpoint write is logged and
+    survived — the run finishes and the final manual save works."""
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
+    faults.install("ckpt_write:fail@1")
+    exp = Experiment(cfg)
+    exp.run(10)  # the step-10 periodic save eats the injected fault
+    assert "checkpoint save failed at step 10" in capsys.readouterr().err
+    assert ckpt.list_checkpoints(exp.run_path) == []
+    path = exp.save()  # hit 2: fine
+    assert ckpt.verify_checkpoint(path)["step"] == 10
+
+
+def test_transient_ckpt_write_fault_absorbed_by_retry(data_root, tmp_path):
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
+    exp = Experiment(cfg)
+    exp.run(10)
+    faults.install("ckpt_write:transient@2")
+    path = exp._save_periodic()  # two transients, then success
+    assert path is not None
+    assert ckpt.verify_checkpoint(path)["step"] == 10
+
+
+def test_train_step_fault_dumps_batch_and_surfaces(data_root, tmp_path):
+    cfg = tiny_config(data_root, run_dir=str(tmp_path / "runs"))
+    faults.install("train_step:fail@3")
+    exp = Experiment(cfg)
+    with pytest.raises(faults.InjectedFailure):
+        exp.run(10)
+    assert exp.step == 2  # two clean steps before the injected failure
+    dump = np.load(os.path.join(exp.run_path, "bad_batch.npz"))
+    assert dump["packed"].shape == (cfg.batch_size, 9, 19, 19)
+
+
+# ---- the full kill-and-resume integration path ----
+
+
+def run_cli(args, rundir, data_root, tmp, faults_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DEEPGO_FAULTS", None)
+    if faults_env:
+        env["DEEPGO_FAULTS"] = faults_env
+    sets = [
+        "name=killtest", "num_layers=2", "channels=8", "batch_size=8",
+        "rate=0.05", "validation_size=16", "validation_interval=5",
+        "print_interval=5", f"data_root={data_root}",
+        "train_split=validation", "validation_split=test",
+        "loader_threads=0", "data_parallel=1", "keep_checkpoints=0",
+    ]
+    cmd = [sys.executable, "-m", "deepgo_tpu.cli", "train",
+           "--iters", "12", "--auto-resume", rundir, "--set", *sets] + args
+    return subprocess.run(cmd, cwd=REPO_ROOT, env=env, capture_output=True,
+                          text=True, timeout=300)
+
+
+@pytest.mark.slow
+def test_kill_and_auto_resume_matches_uninterrupted(data_root, tmp_path):
+    """Acceptance: a run SIGKILLed mid-training by an injected fault
+    auto-resumes from the latest valid checkpoint and reaches the same
+    final params — and the same EWMA and validation history — as an
+    uninterrupted run of equal total steps."""
+    killed_dir = str(tmp_path / "killed")
+    clean_dir = str(tmp_path / "clean")
+
+    # 1. train with an injected SIGKILL at step 7 (checkpoint lands at 5)
+    r1 = run_cli([], killed_dir, data_root, tmp_path,
+                 faults_env="kill:step@7")
+    assert r1.returncode == -9, r1.stderr
+    assert ckpt.find_latest_valid(killed_dir) is not None
+
+    # 2. identical command, no faults: auto-resume to the 12-step target
+    r2 = run_cli([], killed_dir, data_root, tmp_path)
+    assert r2.returncode == 0, r2.stderr + r2.stdout
+    assert "auto-resumed" in r2.stdout
+
+    # 3. uninterrupted reference run of equal total steps
+    r3 = run_cli([], clean_dir, data_root, tmp_path)
+    assert r3.returncode == 0, r3.stderr + r3.stdout
+
+    killed_final = os.path.join(killed_dir, ckpt.checkpoint_name(12))
+    clean_final = os.path.join(clean_dir, ckpt.checkpoint_name(12))
+    meta_k, p_k, o_k = ckpt.load_checkpoint(killed_final)
+    meta_c, p_c, o_c = ckpt.load_checkpoint(clean_final)
+    for a, b in zip(p_k + o_k, p_c + o_c):
+        np.testing.assert_array_equal(a, b)
+    assert meta_k["ewma"] == meta_c["ewma"]
+    keys = ("step", "cost", "accuracy", "n")
+    assert ([{k: v[k] for k in keys} for v in meta_k["validation_history"]]
+            == [{k: v[k] for k in keys} for v in meta_c["validation_history"]])
+
+    # 4. idempotence: the target is met, a re-run is a no-op
+    r4 = run_cli([], killed_dir, data_root, tmp_path)
+    assert r4.returncode == 0
+    assert "nothing to do" in r4.stdout
