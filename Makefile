@@ -1,6 +1,6 @@
 # Developer entry points. `scripts/setup.sh` chains native + data + test.
 
-.PHONY: native data test test-full verify-faults bench smoke clean
+.PHONY: native data test test-full verify-faults verify-serving bench smoke clean
 
 native:
 	$(MAKE) -C native
@@ -18,6 +18,10 @@ test-full:  # every golden position, not the sampled sweep
 verify-faults:  # crash-safety + fault-injection suite, slow kill-and-resume included
 	JAX_PLATFORMS=cpu python -m pytest tests/test_atomicio.py \
 	    tests/test_faults.py tests/test_checkpoint.py tests/test_resume.py -q
+
+verify-serving:  # batching engine: bucket bitwise parity, zero-recompile, lifecycle
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
+	    tests/test_serving_engine.py -q
 
 bench:
 	python bench.py

@@ -177,11 +177,20 @@ def _oneply_scores(packed: np.ndarray, players: np.ndarray,
 
 
 class PolicyAgent(Agent):
-    """The trained CNN, one batched TPU forward per ply."""
+    """The trained CNN, one batched TPU forward per ply.
+
+    ``engine`` (a serving.InferenceEngine over the same params) reroutes
+    inference through the shared micro-batching engine: this agent's
+    batch dissolves into per-board requests that coalesce with every
+    other submitter's — both sides of a self-match, a selfplay fleet, an
+    eval frontend — into one saturated padded dispatch. Without an
+    engine the agent pads its own batch onto the bucket ladder directly
+    (same shapes, same bit-identical rows, no dispatcher thread).
+    """
 
     def __init__(self, params, cfg: policy_cnn.ModelConfig, name: str = "policy",
                  temperature: float = 0.0, pass_threshold: float = 1e-4,
-                 rank: int = 9):
+                 rank: int = 9, engine=None):
         from .models.serving import make_policy_fn
 
         self.params = params
@@ -190,13 +199,17 @@ class PolicyAgent(Agent):
         self.temperature = temperature
         self.pass_threshold = pass_threshold
         self.rank = rank
+        self.engine = engine
         self._predict = make_policy_fn(cfg, top_k=1)
 
     def _legal_log_probs(self, packed, players, legal) -> np.ndarray:
         """One batched forward -> log-probs with illegal points at -inf."""
         ranks = np.full(len(packed), self.rank, dtype=np.int32)
-        logp = batched_log_probs(self._predict, self.params, packed, players,
-                                 ranks)
+        if self.engine is not None:
+            logp = self.engine.evaluate(packed, players, ranks)
+        else:
+            logp = batched_log_probs(self._predict, self.params, packed,
+                                     players, ranks)
         return np.where(legal, logp, -np.inf)
 
     def select_moves(self, packed, players, legal, rng):
@@ -488,30 +501,34 @@ class ValueSearchAgent(PolicySearchAgent):
     name = "value-search"
 
     def __init__(self, params, cfg, value_params, value_cfg,
-                 name: str = "value-search", margin: float = 0.08, **kw):
+                 name: str = "value-search", margin: float = 0.08,
+                 value_engine=None, **kw):
         from .models.serving import make_value_fn
 
         super().__init__(params, cfg, name=name, **kw)
         self.value_params = value_params
         self.value_cfg = value_cfg
         self.margin = margin
+        self.value_engine = value_engine
         self._win_prob = make_value_fn(value_cfg)
 
     def _values(self, boards: np.ndarray, to_move: np.ndarray) -> np.ndarray:
-        """P(side ``to_move`` wins) per board, batch padded to the next
-        power of two so the jitted value forward sees O(log n) distinct
-        shapes (the same guard as selfplay.batched_log_probs)."""
-        n = len(boards)
-        cap = 1 << max(0, n - 1).bit_length() if n > 1 else 1
+        """P(side ``to_move`` wins) per board, padded onto the serving
+        bucket ladder so the jitted value forward only ever sees
+        precompiled shapes (the same guard as selfplay.batched_log_probs;
+        the candidate count varies ply to ply). With a ``value_engine``
+        the boards ride the shared micro-batching engine instead, so a
+        2-ply search's leaf evaluations coalesce with every other value
+        consumer's dispatches."""
         to_move = to_move.astype(np.int32)
-        ranks = np.full(n, self.rank, dtype=np.int32)
-        if cap > n:
-            boards = np.concatenate(
-                [boards, np.zeros((cap - n,) + boards.shape[1:], boards.dtype)])
-            to_move = np.concatenate([to_move, np.ones(cap - n, to_move.dtype)])
-            ranks = np.concatenate([ranks, np.ones(cap - n, ranks.dtype)])
-        return np.asarray(self._win_prob(self.value_params, boards, to_move,
-                                         ranks))[:n]
+        ranks = np.full(len(boards), self.rank, dtype=np.int32)
+        if self.value_engine is not None:
+            return self.value_engine.evaluate(boards, to_move, ranks)
+        from .serving import bucketed_forward, ladder_for
+
+        return bucketed_forward(
+            lambda pk, pl, rk: self._win_prob(self.value_params, pk, pl, rk),
+            boards, to_move, ranks, ladder_for(len(boards)))
 
     def select_moves(self, packed, players, legal, rng):
         legal = _no_own_eyes(packed, players, legal)
@@ -606,8 +623,19 @@ class Value2PlyAgent(ValueSearchAgent):
                             tie_scale=1e-4)
 
 
+def _policy_engine_for(params, cfg, use_engine: bool):
+    """The shared policy engine for this checkpoint, or None. Agents built
+    from the same params then coalesce their per-ply forwards into the
+    same micro-batched dispatches (serving.shared_policy_engine)."""
+    if not use_engine:
+        return None
+    from .serving import shared_policy_engine
+
+    return shared_policy_engine(params, cfg)
+
+
 def _make_agent(spec: str, seed: int, temperature: float = 0.0,
-                rank: int = 9) -> Agent:
+                rank: int = 9, use_engine: bool = False) -> Agent:
     if spec == "random":
         return RandomAgent()
     if spec == "heuristic":
@@ -619,7 +647,8 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
 
         _, params, cfg = load_policy(spec.split(":", 1)[1])
         return PolicyAgent(params, cfg, name="policy", temperature=temperature,
-                           rank=rank)
+                           rank=rank,
+                           engine=_policy_engine_for(params, cfg, use_engine))
     if spec.startswith("search:"):
         from .models.serving import load_policy
 
@@ -627,12 +656,15 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         # policy agents only (see the CLI help); the re-ranker stays
         # deterministic even in a mixed policy-vs-search match
         _, params, cfg = load_policy(spec.split(":", 1)[1])
-        return PolicySearchAgent(params, cfg, rank=rank)
+        return PolicySearchAgent(params, cfg, rank=rank,
+                                 engine=_policy_engine_for(params, cfg,
+                                                           use_engine))
     if spec.startswith("search2:"):
         from .models.serving import load_policy
 
         _, params, cfg = load_policy(spec.split(":", 1)[1])
-        return TwoPlyAgent(params, cfg, rank=rank)
+        return TwoPlyAgent(params, cfg, rank=rank,
+                           engine=_policy_engine_for(params, cfg, use_engine))
     if spec.startswith(("value:", "value2:")):
         from .models.serving import load_policy, load_value
 
@@ -646,12 +678,20 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         _, params, cfg = load_policy(policy_path)
         _, vparams, vcfg = load_value(value_path)
         cls = Value2PlyAgent if kind == "value2" else ValueSearchAgent
-        return cls(params, cfg, vparams, vcfg, rank=rank)
+        value_engine = None
+        if use_engine:
+            from .serving import shared_value_engine
+
+            value_engine = shared_value_engine(vparams, vcfg)
+        return cls(params, cfg, vparams, vcfg, rank=rank,
+                   engine=_policy_engine_for(params, cfg, use_engine),
+                   value_engine=value_engine)
     if spec.startswith("model:"):  # random-init policy, for smoke runs
         cfg = policy_cnn.CONFIGS[spec.split(":", 1)[1]]
         params = policy_cnn.init(jax.random.key(seed), cfg)
         return PolicyAgent(params, cfg, name=f"init-{spec.split(':', 1)[1]}",
-                           temperature=temperature, rank=rank)
+                           temperature=temperature, rank=rank,
+                           engine=_policy_engine_for(params, cfg, use_engine))
     raise ValueError(
         f"unknown agent spec {spec!r} "
         "(use random | heuristic | oneply | checkpoint:PATH | search:PATH "

@@ -14,10 +14,17 @@ from .agents import (  # noqa: F401
     W_LADDER, W_LIB,
     W_OPP_LIB, W_SAVE, W_SELF_ATARI, _apply_and_summarize,
     _argmax_random_tiebreak, _make_agent, _no_own_eyes, _oneply_scores,
-    _play_candidates, _tactical_grids, _topk_mask, _veto_select,
+    _play_candidates, _policy_engine_for, _tactical_grids, _topk_mask,
+    _veto_select,
 )
 from .match import main, play_match  # noqa: F401
 from .selfplay import GameState  # noqa: F401
+# serving-engine surface, so arena-level tools can opt their agents into
+# the shared micro-batching evaluator without a second import path
+from .serving import (  # noqa: F401
+    EngineConfig, InferenceEngine, close_shared_engines,
+    shared_policy_engine, shared_value_engine,
+)
 
 if __name__ == "__main__":
     main()

@@ -4,6 +4,9 @@
   python -m deepgo_tpu.cli eval        evaluate a checkpoint on a split
   python -m deepgo_tpu.cli localtest   20-iteration CPU-size smoke run on the
                                        bundled fixture (reference localtest.lua)
+  python -m deepgo_tpu.cli selfplay    engine-driven batched self-play
+                                       (forwards to deepgo_tpu.selfplay;
+                                       inference rides the serving engine)
 
 Config overrides are ``--set key=value`` pairs against ExperimentConfig
 (the reference's prototype-override tables, experiments.lua:19-31, and its
@@ -111,6 +114,17 @@ def cmd_localtest(args) -> None:
 
 
 def main(argv=None) -> None:
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    if argv[:1] == ["selfplay"]:
+        # plain forwarding, before argparse: REMAINDER cannot capture
+        # leading --flags, and the selfplay driver owns its own help
+        from . import selfplay
+
+        honor_platform_env()
+        return selfplay.main(argv[1:])
+
     ap = argparse.ArgumentParser(prog="deepgo_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -136,6 +150,12 @@ def main(argv=None) -> None:
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
     p.set_defaults(fn=cmd_localtest)
+
+    # "selfplay" is forwarded before parsing (above); listed here so it
+    # shows up in --help output
+    sub.add_parser("selfplay", help="engine-driven batched self-play "
+                                    "(flags forward to deepgo_tpu.selfplay, "
+                                    "e.g. --games 32 --max-wait-ms 2)")
 
     args = ap.parse_args(argv)
     honor_platform_env()

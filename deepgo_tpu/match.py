@@ -171,17 +171,31 @@ def main(argv=None) -> None:
                          "matches (the color-swapped rematch shares the "
                          "opening, keeping the pairing fair)")
     ap.add_argument("--sgf-out", help="directory to write scored games")
+    ap.add_argument("--engine", action="store_true",
+                    help="route net-backed agents through the shared "
+                         "micro-batching inference engine "
+                         "(deepgo_tpu.serving): both sides of a match "
+                         "built from the same checkpoint coalesce into "
+                         "the same padded dispatches (docs/serving.md)")
     args = ap.parse_args(argv)
 
     from .utils import honor_platform_env
 
     honor_platform_env()
-    agent_a = _make_agent(args.a, args.seed, args.temperature, args.rank)
-    agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank)
-    games, scores, stats = play_match(agent_a, agent_b, n_games=args.games,
-                                      komi=args.komi, max_moves=args.max_moves,
-                                      seed=args.seed,
-                                      opening_plies=args.opening_plies)
+    agent_a = _make_agent(args.a, args.seed, args.temperature, args.rank,
+                          use_engine=args.engine)
+    agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank,
+                          use_engine=args.engine)
+    try:
+        games, scores, stats = play_match(
+            agent_a, agent_b, n_games=args.games, komi=args.komi,
+            max_moves=args.max_moves, seed=args.seed,
+            opening_plies=args.opening_plies)
+    finally:
+        if args.engine:
+            from .serving import close_shared_engines
+
+            close_shared_engines()
     print({k: round(v, 3) if isinstance(v, float) else v
            for k, v in stats.items()})
 

@@ -4,6 +4,12 @@ The reference has no separate serving stack — batched ``model:forward`` over
 board tensors IS inference (SURVEY.md section 3.4). This module packages
 that capability properly: a jitted predict function from packed records to
 move probabilities and ranked moves, loadable straight from a checkpoint.
+
+Production callers should not hit these forwards shape-by-shape: the
+``deepgo_tpu.serving`` package wraps them in a shape-bucketed
+micro-batching engine (compile-once ladder, coalesced dispatch, metrics)
+— see docs/serving.md. ``make_log_prob_fn`` below is the engine-facing
+raw forward.
 """
 
 from __future__ import annotations
@@ -36,6 +42,26 @@ def make_policy_fn(cfg: policy_cnn.ModelConfig, top_k: int = 5,
                 "top_probs": top_probs}
 
     return predict
+
+
+def make_log_prob_fn(cfg: policy_cnn.ModelConfig, expand_backend: str = "xla"):
+    """predict(params, packed, player, rank) -> (B, 361) log-probs.
+
+    The raw row-independent forward the serving engine batches
+    (deepgo_tpu.serving): identical math to ``make_policy_fn`` without
+    the top-k ranking, which is host work the engine's consumers do (or
+    skip) themselves. Row independence is what makes bucket padding
+    bit-exact, so this function must never grow a cross-batch term.
+    """
+    expand_planes = get_expand_fn(expand_backend)
+
+    @jax.jit
+    def log_probs(params, packed, player, rank):
+        planes = expand_planes(packed, player, rank,
+                               dtype=jnp.dtype(cfg.compute_dtype))
+        return policy_cnn.log_policy(params, planes, cfg)
+
+    return log_probs
 
 
 def make_sym_policy_fn(cfg: policy_cnn.ModelConfig,

@@ -1,11 +1,15 @@
 """Batched self-play: the policy network playing itself.
 
 BASELINE.md config 5 ("batched self-play policy inference") realized as an
-actual driver, not just a forward-pass benchmark: N games advance in
-lockstep, the host summarizes each live board into a packed record (native
-C++ engine when available), one batched TPU forward scores all of them, and
-each game plays its best *legal* move (legality = empty and not suicide,
-straight from the packed liberties-after channel — no second rules query).
+actual driver, not just a forward-pass benchmark: the host summarizes each
+live board into a packed record (native C++ engine when available), every
+game submits its board to the micro-batching inference engine
+(deepgo_tpu.serving) as an independent request, the dispatcher coalesces
+the fleet into one padded TPU forward per ply, and each game plays its
+best *legal* move (legality = empty and not suicide, straight from the
+packed liberties-after channel — no second rules query). Because batches
+pad onto the engine's precompiled bucket ladder, games finishing at mixed
+lengths never trigger a recompile or distort the dispatch shape.
 
 Games end on double pass — a player passes when no legal move is left or
 when its best move's probability falls below ``pass_threshold`` — or at
@@ -32,7 +36,8 @@ from .features import P_LIB_AFTER, P_STONES
 from .go import (group_and_liberties, native, neighbors, new_board, play,
                  summarize)
 from .models import policy_cnn
-from .models.serving import make_policy_fn
+from .serving import (BucketLadder, EngineConfig, bucketed_forward,
+                      ladder_for, policy_engine)
 from .sgf import Move, coord_to_sgf
 
 
@@ -164,23 +169,22 @@ def legal_mask(packed: np.ndarray, players: np.ndarray,
 
 
 def batched_log_probs(predict, params, packed: np.ndarray,
-                      players: np.ndarray, ranks: np.ndarray) -> np.ndarray:
-    """Policy log-probs with the batch padded to the next power of two.
+                      players: np.ndarray, ranks: np.ndarray,
+                      ladder: BucketLadder | None = None) -> np.ndarray:
+    """Policy log-probs with the batch padded onto the serving bucket
+    ladder (deepgo_tpu.serving.buckets).
 
-    Game batches shrink irregularly as games finish; padding keeps the
-    number of distinct shapes ``jit`` ever sees at O(log n) instead of
-    recompiling for every batch size.
+    Game batches shrink irregularly as games finish; the ladder keeps the
+    set of shapes ``jit`` ever sees to a handful of precompiled rungs
+    instead of recompiling per batch size, and the padded rows are
+    bit-identical to an unpadded forward (row-independent model). This is
+    the direct, threadless path for a single lockstep caller; concurrent
+    submitters should share an ``serving.InferenceEngine`` instead.
     """
-    n = len(packed)
-    cap = 1 << max(0, n - 1).bit_length() if n > 1 else 1
-    if cap > n:
-        packed = np.concatenate(
-            [packed, np.zeros((cap - n,) + packed.shape[1:], packed.dtype)])
-        players = np.concatenate([players, np.ones(cap - n, players.dtype)])
-        ranks = np.concatenate([ranks, np.ones(cap - n, ranks.dtype)])
-    out = predict(params, jnp.asarray(packed), jnp.asarray(players),
-                  jnp.asarray(ranks))
-    return np.asarray(out["log_probs"])[:n]
+    return bucketed_forward(
+        lambda pk, pl, rk: predict(params, jnp.asarray(pk), jnp.asarray(pl),
+                                   jnp.asarray(rk))["log_probs"],
+        packed, players, ranks, ladder or ladder_for(len(packed)))
 
 
 def select_from_log_probs(row: np.ndarray, temperature: float,
@@ -206,40 +210,70 @@ def select_from_log_probs(row: np.ndarray, temperature: float,
 
 def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
               max_moves: int = 361, temperature: float = 0.0,
-              pass_threshold: float = 1e-4, rank: int = 9, seed: int = 0):
-    """Play n_games to completion; returns (games, stats)."""
-    predict = make_policy_fn(cfg, top_k=1)
+              pass_threshold: float = 1e-4, rank: int = 9, seed: int = 0,
+              engine=None, max_wait_ms: float = 2.0):
+    """Play n_games to completion; returns (games, stats).
+
+    Inference rides the micro-batching engine (deepgo_tpu.serving): each
+    live game submits its own board and gets a future, instead of the
+    fleet advancing as one lockstep batch. The dispatcher coalesces the
+    submissions, pads to a precompiled bucket, and answers them in one
+    device dispatch — so as games finish at mixed lengths the shrinking
+    fleet never shows the compiler a new shape, and other workloads
+    sharing the engine (arena agents, an eval frontend) ride the same
+    saturated dispatches. Pass ``engine`` to share one; by default the
+    run builds a private engine over a ladder trimmed to ``n_games``,
+    warms every rung, and closes it on exit. ``stats["engine"]`` carries
+    the engine's occupancy/latency/bucket counters.
+    """
+    own_engine = engine is None
+    if own_engine:
+        engine = policy_engine(
+            params, cfg,
+            config=EngineConfig(buckets=ladder_for(n_games).buckets,
+                                max_wait_ms=max_wait_ms))
+        engine.warmup()
     rng = np.random.default_rng(seed)
     games = [GameState() for _ in range(n_games)]
     positions = 0
     t0 = time.time()
 
-    while True:
-        active = [g for g in games if not g.done]
-        if not active:
-            break
-        packed = summarize_states(active)
-        players = np.array([g.player for g in active], dtype=np.int32)
-        ranks = np.full(len(active), rank, dtype=np.int32)
-        logp = batched_log_probs(predict, params, packed, players, ranks)
-        positions += len(active)
+    try:
+        while True:
+            active = [g for g in games if not g.done]
+            if not active:
+                break
+            packed = summarize_states(active)
+            players = np.array([g.player for g in active], dtype=np.int32)
 
-        legal = legal_mask(packed, players, active)
-        logp = np.where(legal, logp, -np.inf)
+            # every game is an independent submitter: futures out, one
+            # coalesced dispatch behind them
+            futures = [engine.submit(packed[i], int(players[i]), rank)
+                       for i in range(len(active))]
+            logp = np.stack([f.result() for f in futures])
+            positions += len(active)
 
-        step_games(active, [
-            select_from_log_probs(logp[i], temperature, pass_threshold, rng)
-            for i in range(len(active))], max_moves)
+            legal = legal_mask(packed, players, active)
+            logp = np.where(legal, logp, -np.inf)
 
-    dt = time.time() - t0
-    stats = {
-        "games": n_games,
-        "positions": positions,
-        "seconds": dt,
-        "positions_per_sec": positions / dt,
-        "mean_moves": float(np.mean([len(g.moves) for g in games])),
-    }
-    return games, stats
+            step_games(active, [
+                select_from_log_probs(logp[i], temperature, pass_threshold,
+                                      rng)
+                for i in range(len(active))], max_moves)
+
+        dt = time.time() - t0
+        stats = {
+            "games": n_games,
+            "positions": positions,
+            "seconds": dt,
+            "positions_per_sec": positions / dt,
+            "mean_moves": float(np.mean([len(g.moves) for g in games])),
+            "engine": engine.stats(),
+        }
+        return games, stats
+    finally:
+        if own_engine:
+            engine.close()
 
 
 def to_sgf(game: GameState, black_rank: int = 9, white_rank: int = 9,
@@ -267,6 +301,10 @@ def main(argv=None) -> None:
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--sgf-out", help="directory to write finished games")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0,
+                    help="engine coalescing window: how long the "
+                         "dispatcher waits for more submitters before "
+                         "padding and dispatching (docs/serving.md)")
     args = ap.parse_args(argv)
 
     from .utils import honor_platform_env
@@ -283,7 +321,8 @@ def main(argv=None) -> None:
 
     games, stats = self_play(params, cfg, n_games=args.games,
                              max_moves=args.max_moves,
-                             temperature=args.temperature, seed=args.seed)
+                             temperature=args.temperature, seed=args.seed,
+                             max_wait_ms=args.max_wait_ms)
     print({k: round(v, 2) if isinstance(v, float) else v
            for k, v in stats.items()})
 

@@ -1,0 +1,90 @@
+"""Production-shaped inference serving: bucket ladder + micro-batch engine.
+
+The layer every inference workload calls into (ROADMAP north star:
+"serves heavy traffic ... as fast as the hardware allows"):
+
+  * buckets.py — shape-bucket compile cache: any request count pads onto
+    a fixed ladder of precompiled batch sizes, so after warmup no shape
+    ever recompiles and padded rows stay bit-identical to unpadded ones.
+  * engine.py — micro-batching queue: callers submit single boards and
+    get futures; a dispatcher coalesces, pads, runs one device dispatch,
+    scatters rows back. Bounded queue, per-request timeouts, engine
+    metrics (p50/p99, occupancy, bucket histogram, boards/sec).
+
+Factories below wire the engine to the models; ``shared_policy_engine`` /
+``shared_value_engine`` memoize per (params, config) so mixed workloads —
+selfplay, policy agents, 2-ply value search, arena matches — share one
+saturated evaluator instead of each trickling its own device calls.
+"""
+
+from __future__ import annotations
+
+from .buckets import (DEFAULT_BUCKETS, BucketLadder,  # noqa: F401
+                      bucketed_forward)
+from .engine import (EngineBusy, EngineClosed, EngineConfig,  # noqa: F401
+                     EngineError, InferenceEngine)
+
+
+def ladder_for(n_games: int, buckets=DEFAULT_BUCKETS) -> BucketLadder:
+    """The default ladder trimmed to a known fleet size: rungs above the
+    smallest one covering ``n_games`` are dead weight (warmup compiles
+    nobody dispatches), so a 32-game selfplay run warms (1, 8, 32). A
+    fleet larger than the top rung keeps the full ladder — oversize
+    batches dispatch as top-rung chunks (BucketLadder.plan)."""
+    keep = [b for b in sorted(buckets) if b < n_games]
+    ceil = [b for b in sorted(buckets) if b >= n_games]
+    return BucketLadder(tuple(keep + ceil[:1]))
+
+
+def policy_engine(params, cfg, config: EngineConfig | None = None,
+                  expand_backend: str = "xla", metrics=None,
+                  name: str = "policy") -> InferenceEngine:
+    """Engine over the policy forward: rows are (361,) log-probs."""
+    from ..models.serving import make_log_prob_fn
+
+    return InferenceEngine(make_log_prob_fn(cfg, expand_backend), params,
+                           config=config, name=name, metrics=metrics)
+
+
+def value_engine(params, cfg, config: EngineConfig | None = None,
+                 metrics=None, name: str = "value") -> InferenceEngine:
+    """Engine over the value forward: rows are scalar win-probs."""
+    from ..models.serving import make_value_fn
+
+    return InferenceEngine(make_value_fn(cfg), params, config=config,
+                           name=name, metrics=metrics)
+
+
+# One engine per live (params, model config, engine config): agents built
+# from the same checkpoint — a policy player and the value searcher's
+# prior, both sides of a self-match — coalesce into the same dispatches.
+_SHARED: dict[tuple, InferenceEngine] = {}
+
+
+def _shared(kind: str, factory, params, cfg,
+            config: EngineConfig | None) -> InferenceEngine:
+    key = (kind, id(params), cfg, config)
+    engine = _SHARED.get(key)
+    if engine is None or engine._closing.is_set():
+        engine = _SHARED[key] = factory(params, cfg, config=config,
+                                        name=f"shared-{kind}")
+    return engine
+
+
+def shared_policy_engine(params, cfg,
+                         config: EngineConfig | None = None
+                         ) -> InferenceEngine:
+    return _shared("policy", policy_engine, params, cfg, config)
+
+
+def shared_value_engine(params, cfg,
+                        config: EngineConfig | None = None
+                        ) -> InferenceEngine:
+    return _shared("value", value_engine, params, cfg, config)
+
+
+def close_shared_engines() -> None:
+    """Drain and drop every registry engine (match CLI teardown)."""
+    while _SHARED:
+        _, engine = _SHARED.popitem()
+        engine.close()

@@ -1,0 +1,99 @@
+"""Shape-bucket compile cache: pad any request count onto a fixed ladder.
+
+A jitted forward compiles once per distinct batch shape, and a workload
+whose live batch shrinks through arbitrary sizes (self-play as games
+finish, a serving queue under variable load) would trigger a fresh XLA
+compile per size. The fix is the FireCaffe discipline (arXiv:1511.00175)
+applied to inference: keep a small ladder of fixed batch sizes on the
+accelerator and pad every request count up to the nearest rung, so after
+one warmup pass over the ladder no request shape ever compiles again.
+
+Padding is free of numerical consequence here: each board's forward is
+row-independent (conv stack, no cross-batch reduction), so the first n
+rows of a padded forward are BIT-IDENTICAL to the unpadded forward —
+tests/test_serving_engine.py asserts equality with ``==``, not allclose.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# The default rung spacing (~4x) keeps warmup to five compiles while
+# capping pad waste at 4x on the smallest requests; 512 saturates the
+# flagship net on one chip (bench.py runs it at 8192 only by stacking).
+DEFAULT_BUCKETS = (1, 8, 32, 128, 512)
+
+# Padding rows: an empty board scored for player 1 at rank 1 — the same
+# filler selfplay.batched_log_probs always used, kept so padded dispatch
+# stays comparable across the engine and the legacy helpers.
+PAD_PLAYER = 1
+PAD_RANK = 1
+
+
+class BucketLadder:
+    """An ascending ladder of batch sizes plus the pad/plan arithmetic."""
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        rungs = tuple(sorted({int(b) for b in buckets}))
+        if not rungs or rungs[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        self.buckets = rungs
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest rung >= n. Raises for n over the top rung — callers
+        split oversize batches with plan() instead of padding down."""
+        if n < 1:
+            raise ValueError(f"need at least one request, got {n}")
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"{n} exceeds the largest bucket {self.max_bucket}")
+
+    def plan(self, n: int) -> list[tuple[int, int, int]]:
+        """Cover n rows with ladder-shaped dispatches:
+        ``[(start, count, bucket), ...]``. Full top-rung chunks first (no
+        padding), then one padded dispatch for the remainder."""
+        out, start = [], 0
+        while n - start >= self.max_bucket:
+            out.append((start, self.max_bucket, self.max_bucket))
+            start += self.max_bucket
+        rest = n - start
+        if rest:
+            out.append((start, rest, self.bucket_for(rest)))
+        return out
+
+    def pad(self, packed: np.ndarray, players: np.ndarray, ranks: np.ndarray,
+            bucket: int):
+        """(packed, players, ranks) padded with empty-board filler rows up
+        to ``bucket``; no copy when the count already sits on a rung."""
+        n = len(packed)
+        if bucket == n:
+            return packed, players, ranks
+        pad = bucket - n
+        return (
+            np.concatenate(
+                [packed, np.zeros((pad,) + packed.shape[1:], packed.dtype)]),
+            np.concatenate(
+                [players, np.full(pad, PAD_PLAYER, players.dtype)]),
+            np.concatenate([ranks, np.full(pad, PAD_RANK, ranks.dtype)]),
+        )
+
+
+def bucketed_forward(fn, packed: np.ndarray, players: np.ndarray,
+                     ranks: np.ndarray, ladder: BucketLadder) -> np.ndarray:
+    """Run ``fn(packed, players, ranks) -> (B, ...)`` over the ladder.
+
+    Any request count dispatches as top-rung chunks plus one padded
+    remainder, so ``fn`` (a jitted forward) only ever sees ladder shapes.
+    Returns the first-n rows as one host array.
+    """
+    parts = []
+    for start, count, bucket in ladder.plan(len(packed)):
+        sl = slice(start, start + count)
+        p, pl, rk = ladder.pad(packed[sl], players[sl], ranks[sl], bucket)
+        parts.append(np.asarray(fn(p, pl, rk))[:count])
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)

@@ -1,0 +1,323 @@
+"""Micro-batching inference engine: futures in, one padded dispatch out.
+
+Callers — selfplay games, arena agents, an eventual GTP/eval frontend —
+submit single-board requests and get ``concurrent.futures.Future``s. A
+dispatcher thread coalesces up to ``max_bucket`` requests or
+``max_wait_ms``, pads the batch onto the bucket ladder (buckets.py), runs
+ONE device dispatch, and scatters result rows back to the futures. The
+queue is bounded (backpressure: a flooded engine pushes back on
+submitters instead of growing without bound), requests carry optional
+deadlines, and dispatcher death surfaces on the next ``submit()`` — the
+same worker-death contract as data.loader.AsyncLoader, for the same
+reason: a silently dead thread turns every waiter into a deadlock.
+
+Batching changes nothing numerically: forwards are row-independent, so a
+request's row is bit-identical whether it rode alone or in a full bucket
+(tests assert ``==``). What batching buys is throughput — one dispatch
+amortizes host->device transfer and XLA dispatch overhead across every
+coalesced request, the serving-side twin of the training loader's
+superbatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from .buckets import DEFAULT_BUCKETS, BucketLadder
+
+
+class EngineError(RuntimeError):
+    """Base class for serving-engine failures."""
+
+
+class EngineClosed(EngineError):
+    """submit() after close(), or a pending request cancelled by close()."""
+
+
+class EngineBusy(EngineError):
+    """Non-blocking submit() against a full request queue (backpressure)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Knobs for one engine. ``max_wait_ms`` is the latency/throughput
+    trade: 0 dispatches whatever is queued immediately (lowest latency,
+    worst occupancy under trickle load); a few ms lets concurrent
+    submitters coalesce into one saturated dispatch (docs/serving.md)."""
+
+    buckets: tuple[int, ...] = DEFAULT_BUCKETS
+    max_wait_ms: float = 2.0
+    max_queue: int = 4096
+    timeout_s: float | None = None      # default per-request deadline
+    latency_window: int = 2048          # samples kept for p50/p99
+    metrics_interval: int = 100         # dispatches between metrics records
+
+
+class _Request:
+    __slots__ = ("packed", "player", "rank", "future", "t_submit", "deadline")
+
+    def __init__(self, packed, player, rank, deadline):
+        self.packed = packed
+        self.player = player
+        self.rank = rank
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+        self.deadline = deadline
+
+
+class InferenceEngine:
+    """One model, one dispatcher thread, many concurrent submitters.
+
+    ``forward(params, packed, player, rank) -> (B, ...)`` is any jitted
+    row-independent forward (policy log-probs, value win-probs); the
+    engine is agnostic to what the rows mean.
+    """
+
+    def __init__(self, forward, params, config: EngineConfig | None = None,
+                 name: str = "policy", metrics=None):
+        self.config = config or EngineConfig()
+        self.ladder = BucketLadder(self.config.buckets)
+        self.name = name
+        self._forward = forward
+        self._params = params
+        self._metrics = metrics
+        self._queue: queue.Queue[_Request] = queue.Queue(
+            maxsize=self.config.max_queue)
+        self._closing = threading.Event()   # no new submits
+        self._cancel = threading.Event()    # fail pending instead of draining
+        self._error: BaseException | None = None
+        self._lock = threading.Lock()
+        self._latencies: deque[float] = deque(maxlen=self.config.latency_window)
+        self._bucket_hits: dict[int, int] = {}
+        self._dispatches = 0
+        self._boards = 0
+        self._padded_boards = 0
+        self._timeouts = 0
+        self._warm_shapes = 0
+        self._t_start = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name=f"serving-{name}", daemon=True)
+        self._thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def warmup(self) -> int:
+        """Compile every ladder rung up front (empty-board batches), so the
+        steady state performs zero compilations. Returns rung count."""
+        for b in self.ladder.buckets:
+            packed = np.zeros((b, 9, 19, 19), dtype=np.uint8)
+            player = np.ones(b, dtype=np.int32)
+            rank = np.ones(b, dtype=np.int32)
+            np.asarray(self._forward(self._params, packed, player, rank))
+        self._warm_shapes = len(self.ladder.buckets)
+        return self._warm_shapes
+
+    def compile_cache_size(self) -> int | None:
+        """Distinct shapes the jitted forward has compiled (None when the
+        callable doesn't expose its jit cache) — what the zero-recompile
+        tests assert stays flat after warmup."""
+        cache_size = getattr(self._forward, "_cache_size", None)
+        return cache_size() if callable(cache_size) else None
+
+    def _check_alive(self) -> None:
+        if self._error is not None:
+            raise EngineError(
+                f"InferenceEngine[{self.name}] dispatcher thread died"
+            ) from self._error
+        if self._closing.is_set():
+            raise EngineClosed(f"InferenceEngine[{self.name}] is closed")
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting work and shut the dispatcher down.
+
+        ``drain=True`` processes everything already queued before the
+        thread exits (every pending future resolves); ``drain=False``
+        fails pending futures with EngineClosed instead. Either way
+        close() returns once the thread is joined — it never leaves
+        waiters hanging on futures nobody will resolve."""
+        if not drain:
+            self._cancel.set()
+        self._closing.set()
+        self._thread.join(timeout=timeout)
+        # belt and braces: anything still queued after the join (thread
+        # died, join timed out) must not strand its waiters
+        self._fail_pending(EngineClosed(
+            f"InferenceEngine[{self.name}] closed with request pending"))
+        if self._metrics is not None:
+            self._metrics.write("serving_close", engine=self.name,
+                                **self.stats())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, packed: np.ndarray, player: int, rank: int,
+               timeout_s: float | None = None, block: bool = True) -> Future:
+        """Queue one board; returns a Future resolving to its result row.
+
+        ``timeout_s`` (default: config.timeout_s) bounds queue-to-result
+        time — an expired request fails with TimeoutError instead of
+        occupying a dispatch. With ``block=False`` a full queue raises
+        EngineBusy immediately; blocking submits wait for space but keep
+        re-checking engine liveness so a dead dispatcher can't strand
+        them."""
+        self._check_alive()
+        timeout_s = self.config.timeout_s if timeout_s is None else timeout_s
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        req = _Request(np.asarray(packed), int(player), int(rank), deadline)
+        while True:
+            try:
+                self._queue.put(req, block=block, timeout=0.1)
+                return req.future
+            except queue.Full:
+                if not block:
+                    raise EngineBusy(
+                        f"InferenceEngine[{self.name}] queue full "
+                        f"({self.config.max_queue} pending)") from None
+                self._check_alive()
+
+    def evaluate(self, packed: np.ndarray, players: np.ndarray,
+                 ranks: np.ndarray, timeout_s: float | None = None
+                 ) -> np.ndarray:
+        """Blocking convenience: submit every row, gather in order.
+
+        This is how the lockstep drivers (match harness, corpus tools)
+        ride the engine — their batch dissolves into independent requests
+        that coalesce with whatever else is in flight."""
+        futures = [self.submit(packed[i], int(players[i]), int(ranks[i]),
+                               timeout_s=timeout_s)
+                   for i in range(len(packed))]
+        return np.stack([f.result() for f in futures])
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _collect(self) -> list[_Request] | None:
+        """One coalescing window: block for the first request, then gather
+        until the ladder's top rung fills or ``max_wait_ms`` elapses.
+        Returns None when closing and the queue is empty."""
+        while True:
+            try:
+                first = self._queue.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if self._closing.is_set():
+                    return None
+        batch = [first]
+        t_end = time.monotonic() + self.config.max_wait_ms / 1000.0
+        while len(batch) < self.ladder.max_bucket:
+            # a closing engine stops waiting for stragglers: drain eagerly
+            remaining = 0.0 if self._closing.is_set() \
+                else t_end - time.monotonic()
+            try:
+                batch.append(self._queue.get(
+                    block=remaining > 0, timeout=max(remaining, 0.0) or None))
+            except queue.Empty:
+                break
+        return batch
+
+    def _dispatch(self, batch: list[_Request]) -> None:
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                r.future.set_exception(TimeoutError(
+                    f"request expired after {now - r.t_submit:.3f}s in "
+                    f"InferenceEngine[{self.name}] queue"))
+                with self._lock:
+                    self._timeouts += 1
+            elif r.future.set_running_or_notify_cancel():
+                live.append(r)
+        if not live:
+            return
+        n = len(live)
+        bucket = self.ladder.bucket_for(n)
+        packed, players, ranks = self.ladder.pad(
+            np.stack([r.packed for r in live]),
+            np.array([r.player for r in live], dtype=np.int32),
+            np.array([r.rank for r in live], dtype=np.int32), bucket)
+        out = np.asarray(self._forward(self._params, packed, players, ranks))
+        t_done = time.monotonic()
+        for i, r in enumerate(live):
+            r.future.set_result(out[i])
+        with self._lock:
+            self._dispatches += 1
+            self._boards += n
+            self._padded_boards += bucket
+            self._bucket_hits[bucket] = self._bucket_hits.get(bucket, 0) + 1
+            self._latencies.extend(t_done - r.t_submit for r in live)
+            write_metrics = (
+                self._metrics is not None
+                and self._dispatches % self.config.metrics_interval == 0)
+        if write_metrics:
+            self._metrics.write("serving", engine=self.name, **self.stats())
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if not req.future.done():
+                req.future.set_exception(exc)
+
+    def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                if self._cancel.is_set():
+                    self._fail_pending(EngineClosed(
+                        f"InferenceEngine[{self.name}] closed before "
+                        "this request dispatched"))
+                    return
+                batch = self._collect()
+                if batch is None:
+                    return
+                self._dispatch(batch)
+        except BaseException as e:  # noqa: BLE001 — surfaced via submit()
+            # AsyncLoader._worker's contract: stash the error, fail every
+            # in-flight future, and let the next submit() re-raise it —
+            # never leave waiters blocked on futures a dead thread owns.
+            self._error = e
+            self._closing.set()
+            if "batch" in locals() and batch:
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+            self._fail_pending(e)
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Snapshot of the engine counters: request p50/p99 latency (ms,
+        submit-to-result over the sliding window), mean batch occupancy
+        (real boards / padded boards — the pad-waste measure), per-bucket
+        dispatch histogram, and boards/sec since construction."""
+        with self._lock:
+            lat = np.array(self._latencies, dtype=np.float64)
+            dt = max(time.monotonic() - self._t_start, 1e-9)
+            return {
+                "dispatches": self._dispatches,
+                "boards": self._boards,
+                "boards_per_sec": round(self._boards / dt, 1),
+                "occupancy": round(
+                    self._boards / self._padded_boards, 4)
+                if self._padded_boards else None,
+                "bucket_hits": {str(k): v for k, v in
+                                sorted(self._bucket_hits.items())},
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1000, 3)
+                if lat.size else None,
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1000, 3)
+                if lat.size else None,
+                "timeouts": self._timeouts,
+                "warm_shapes": self._warm_shapes,
+            }

@@ -1,0 +1,333 @@
+"""Shape-bucketed micro-batching engine tests (deepgo_tpu.serving).
+
+The two load-bearing properties:
+  * padded+masked engine outputs are BIT-identical (``==``, not allclose)
+    to a direct unpadded forward, for every bucket size — padding is a
+    pure throughput move with zero numerical consequence;
+  * after warming the ladder, a selfplay run with mixed game lengths
+    performs zero additional XLA compilations — asserted via the jitted
+    forward's compile-cache counter.
+Plus the lifecycle contract: dispatcher death surfaces on the next
+submit() (the AsyncLoader worker-death pattern), and close() drains or
+cancels pending futures instead of hanging.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepgo_tpu.models import ModelConfig, init
+from deepgo_tpu.models.serving import make_log_prob_fn, make_policy_fn
+from deepgo_tpu.serving import (BucketLadder, EngineBusy, EngineClosed,
+                                EngineConfig, EngineError, InferenceEngine,
+                                bucketed_forward, ladder_for, policy_engine)
+
+
+def tiny():
+    cfg = ModelConfig(num_layers=2, channels=8)
+    return cfg, init(jax.random.key(0), cfg)
+
+
+def boards(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 3, size=(n, 9, 19, 19), dtype=np.uint8),
+            rng.integers(1, 3, size=n).astype(np.int32),
+            rng.integers(1, 10, size=n).astype(np.int32))
+
+
+class TestBucketLadder:
+    def test_bucket_for(self):
+        ladder = BucketLadder((1, 8, 32))
+        assert ladder.bucket_for(1) == 1
+        assert ladder.bucket_for(2) == 8
+        assert ladder.bucket_for(8) == 8
+        assert ladder.bucket_for(9) == 32
+        with pytest.raises(ValueError):
+            ladder.bucket_for(33)
+        with pytest.raises(ValueError):
+            ladder.bucket_for(0)
+
+    def test_plan_covers_and_chunks(self):
+        ladder = BucketLadder((1, 8, 32))
+        assert ladder.plan(5) == [(0, 5, 8)]
+        assert ladder.plan(32) == [(0, 32, 32)]
+        # oversize: full top-rung chunks (unpadded) + padded remainder
+        assert ladder.plan(70) == [(0, 32, 32), (32, 32, 32), (64, 6, 8)]
+
+    def test_invalid_buckets_rejected(self):
+        with pytest.raises(ValueError):
+            BucketLadder(())
+        with pytest.raises(ValueError):
+            BucketLadder((0, 8))
+
+    def test_ladder_for_trims_and_keeps_full(self):
+        assert ladder_for(32).buckets == (1, 8, 32)
+        assert ladder_for(3).buckets == (1, 8)
+        # fleets over the top rung keep the full ladder and chunk
+        assert ladder_for(600).buckets == (1, 8, 32, 128, 512)
+
+    def test_pad_is_noop_on_rung(self):
+        ladder = BucketLadder((4,))
+        p, pl, rk = boards(4)
+        out = ladder.pad(p, pl, rk, 4)
+        assert out[0] is p and out[1] is pl and out[2] is rk
+
+
+class TestBitwiseParity:
+    """Engine log-probs must equal a direct make_policy_fn call with ==."""
+
+    def test_every_bucket_bitwise_identical(self):
+        cfg, params = tiny()
+        predict = make_policy_fn(cfg, top_k=1)
+        buckets = (1, 4, 16)
+        with policy_engine(params, cfg,
+                           config=EngineConfig(buckets=buckets,
+                                               max_wait_ms=0.0)) as engine:
+            engine.warmup()
+            for n in (1, 2, 3, 4, 5, 16):
+                packed, players, ranks = boards(n, seed=n)
+                direct = np.asarray(
+                    predict(params, packed, players, ranks)["log_probs"])
+                got = engine.evaluate(packed, players, ranks)
+                assert np.array_equal(got, direct), f"n={n} not bit-identical"
+
+    def test_one_request_into_largest_bucket(self):
+        # the worst-case pad: a single board into the top rung must still
+        # be bitwise the unpadded single-row forward
+        cfg, params = tiny()
+        predict = make_policy_fn(cfg, top_k=1)
+        packed, players, ranks = boards(1, seed=7)
+        direct = np.asarray(
+            predict(params, packed, players, ranks)["log_probs"])
+        with policy_engine(params, cfg,
+                           config=EngineConfig(buckets=(32,))) as engine:
+            got = engine.evaluate(packed, players, ranks)
+        assert np.array_equal(got, direct)
+
+    def test_oversize_batch_chunks_bitwise(self):
+        # more rows than the top rung: plan() splits into chunks, rows
+        # still bitwise equal to the whole-batch direct forward
+        cfg, params = tiny()
+        fwd = make_log_prob_fn(cfg)
+        packed, players, ranks = boards(11, seed=3)
+        direct = np.asarray(fwd(params, packed, players, ranks))
+        got = bucketed_forward(
+            lambda pk, pl, rk: fwd(params, pk, pl, rk),
+            packed, players, ranks, BucketLadder((1, 4)))
+        assert np.array_equal(got, direct)
+
+
+class TestZeroRecompile:
+    def test_mixed_length_selfplay_never_recompiles(self):
+        # the acceptance criterion: warm the ladder, then play games that
+        # finish at different plies (measured lengths for this seed are
+        # spread over ~3..14 moves), so the live fleet shrinks through
+        # many sizes — and the compile counter must not move
+        from deepgo_tpu.selfplay import self_play
+
+        cfg, params = tiny()
+        engine = policy_engine(
+            params, cfg, config=EngineConfig(buckets=(1, 2, 4, 8)))
+        try:
+            assert engine.warmup() == 4
+            warm = engine.compile_cache_size()
+            assert warm == 4
+            games, stats = self_play(params, cfg, n_games=6, max_moves=40,
+                                     temperature=1.0, pass_threshold=2.6e-3,
+                                     seed=3, engine=engine)
+            lengths = {len(g.moves) for g in games}
+            assert len(lengths) > 2, f"lengths not mixed: {sorted(lengths)}"
+            assert engine.compile_cache_size() == warm, \
+                "selfplay triggered XLA recompilation after warmup"
+            assert stats["engine"]["dispatches"] > 0
+        finally:
+            engine.close()
+
+    def test_direct_ladder_path_never_recompiles(self):
+        # the threadless bucketed_forward path (agents without an engine)
+        # holds the same property: every request count 1..top rung maps
+        # onto the warmed shapes
+        cfg, params = tiny()
+        fwd = make_log_prob_fn(cfg)
+        ladder = BucketLadder((1, 2, 4, 8))
+        for b in ladder.buckets:  # warmup
+            bucketed_forward(lambda pk, pl, rk: fwd(params, pk, pl, rk),
+                             *boards(b), ladder)
+        warm = fwd._cache_size()
+        for n in range(1, 9):
+            bucketed_forward(lambda pk, pl, rk: fwd(params, pk, pl, rk),
+                             *boards(n, seed=n), ladder)
+        assert fwd._cache_size() == warm
+
+
+class TestLifecycle:
+    def test_dispatcher_death_surfaces_on_next_submit(self):
+        # mirror of the AsyncLoader worker-death contract: the poisoned
+        # request's future carries the error, and every later submit()
+        # raises instead of deadlocking its waiter
+        def bomb(params, packed, player, rank):
+            raise ValueError("model exploded")
+
+        engine = InferenceEngine(bomb, None,
+                                 EngineConfig(buckets=(4,), max_wait_ms=0.0))
+        f = engine.submit(*_one_board())
+        with pytest.raises(ValueError, match="model exploded"):
+            f.result(timeout=5)
+        deadline = time.monotonic() + 5
+        while engine._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(EngineError, match="dispatcher thread died"):
+            engine.submit(*_one_board())
+        engine.close()  # must not hang on a dead dispatcher
+
+    def test_close_drains_pending_futures(self):
+        cfg, params = tiny()
+        engine = policy_engine(
+            params, cfg, config=EngineConfig(buckets=(1, 4), max_wait_ms=0.0))
+        futures = [engine.submit(*_one_board(seed=i)) for i in range(6)]
+        engine.close(drain=True)
+        for f in futures:
+            assert f.result(timeout=1).shape == (361,)
+
+    def test_close_cancels_pending_futures(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        def slow(params, packed, player, rank):
+            entered.set()
+            assert release.wait(10)
+            return np.zeros((len(packed), 361), dtype=np.float32)
+
+        engine = InferenceEngine(slow, None,
+                                 EngineConfig(buckets=(1,), max_wait_ms=0.0))
+        in_flight = engine.submit(*_one_board())
+        assert entered.wait(5)  # dispatcher is now stuck inside forward
+        pending = [engine.submit(*_one_board(seed=i)) for i in range(3)]
+
+        closer = threading.Thread(target=lambda: engine.close(drain=False))
+        closer.start()
+        deadline = time.monotonic() + 5
+        while not engine._closing.is_set() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        release.set()
+        closer.join(timeout=10)
+        assert not closer.is_alive(), "close() hung"
+
+        assert in_flight.result(timeout=1).shape == (361,)
+        for f in pending:
+            with pytest.raises(EngineClosed):
+                f.result(timeout=1)
+        with pytest.raises(EngineClosed):
+            engine.submit(*_one_board())
+
+    def test_per_request_timeout(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        def slow(params, packed, player, rank):
+            entered.set()
+            assert release.wait(10)
+            return np.zeros((len(packed), 361), dtype=np.float32)
+
+        engine = InferenceEngine(slow, None,
+                                 EngineConfig(buckets=(1,), max_wait_ms=0.0))
+        try:
+            first = engine.submit(*_one_board())
+            assert entered.wait(5)
+            # queued behind the stuck dispatch with an already-short
+            # deadline: by the time it dispatches it must fail, not run
+            doomed = engine.submit(*_one_board(seed=1), timeout_s=0.01)
+            time.sleep(0.05)
+            entered.clear()
+            release.set()
+            assert first.result(timeout=5).shape == (361,)
+            with pytest.raises(TimeoutError, match="expired"):
+                doomed.result(timeout=5)
+            assert engine.stats()["timeouts"] == 1
+        finally:
+            release.set()
+            engine.close()
+
+    def test_backpressure_queue_full(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        def slow(params, packed, player, rank):
+            entered.set()
+            assert release.wait(10)
+            return np.zeros((len(packed), 361), dtype=np.float32)
+
+        engine = InferenceEngine(
+            slow, None,
+            EngineConfig(buckets=(1,), max_wait_ms=0.0, max_queue=2))
+        try:
+            engine.submit(*_one_board())          # in flight
+            assert entered.wait(5)
+            engine.submit(*_one_board(seed=1))    # queue slot 1
+            engine.submit(*_one_board(seed=2))    # queue slot 2
+            with pytest.raises(EngineBusy, match="queue full"):
+                engine.submit(*_one_board(seed=3), block=False)
+        finally:
+            release.set()
+            engine.close()
+
+
+class TestStats:
+    def test_stats_shape_and_accounting(self):
+        cfg, params = tiny()
+        with policy_engine(params, cfg,
+                           config=EngineConfig(buckets=(1, 4),
+                                               max_wait_ms=0.0)) as engine:
+            engine.warmup()
+            for n in (1, 3, 4):
+                engine.evaluate(*boards(n, seed=n))
+            s = engine.stats()
+        assert s["boards"] == 8
+        assert s["dispatches"] == sum(s["bucket_hits"].values())
+        assert 0 < s["occupancy"] <= 1
+        assert s["p50_ms"] is not None and s["p99_ms"] >= s["p50_ms"]
+        assert s["warm_shapes"] == 2
+        assert s["boards_per_sec"] > 0
+
+    def test_metrics_writer_records(self, tmp_path):
+        from deepgo_tpu.utils.metrics import MetricsWriter, read_jsonl
+
+        cfg, params = tiny()
+        writer = MetricsWriter(str(tmp_path / "serving.jsonl"))
+        engine = policy_engine(
+            params, cfg, metrics=writer,
+            config=EngineConfig(buckets=(1, 4), max_wait_ms=0.0,
+                                metrics_interval=1))
+        engine.evaluate(*boards(3))
+        engine.close()
+        writer.close()
+        records = read_jsonl(str(tmp_path / "serving.jsonl"))
+        kinds = {r["kind"] for r in records}
+        assert "serving" in kinds and "serving_close" in kinds
+        assert records[-1]["boards"] == 3
+
+
+class TestAgentsOnEngine:
+    def test_policy_agent_engine_path_matches_direct(self):
+        from deepgo_tpu.agents import PolicyAgent
+        from deepgo_tpu.selfplay import legal_mask
+
+        cfg, params = tiny()
+        packed, players, _ = boards(5, seed=9)
+        legal = legal_mask(packed, players)
+        with policy_engine(params, cfg,
+                           config=EngineConfig(buckets=(1, 8))) as engine:
+            on_engine = PolicyAgent(params, cfg, engine=engine)
+            direct = PolicyAgent(params, cfg)
+            got = on_engine._legal_log_probs(packed, players, legal)
+            want = direct._legal_log_probs(packed, players, legal)
+        assert np.array_equal(got, want)
+
+
+def _one_board(seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 3, size=(9, 19, 19), dtype=np.uint8), 1, 5)

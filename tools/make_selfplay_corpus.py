@@ -67,7 +67,7 @@ def main(argv=None) -> None:
     deterministic_prefixes = ("search:", "search2:", "value:", "value2:")
     for spec in {s for p in pairs for s in p}:
         # search-family agents are deterministic re-rankers; _make_agent
-        # silently ignores a temperature for all three specs (it is never
+        # silently ignores a temperature for all four specs (it is never
         # forwarded), so the 0.0 pin here changes nothing — it documents
         # at the call site that these agents play greedily
         temp = 0.0 if spec in baseline_rank \

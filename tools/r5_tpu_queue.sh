@@ -79,10 +79,8 @@ run_large13b() {
 run_feed() {
   stage feed
   [ -f runs/r5logs/done_feed ] && { echo "feed already done"; return 0; }
-  # the point of this re-measurement is the parallelized loader assembly;
-  # measuring the old path and marking done would waste the one shot
-  [ -f runs/r5logs/loader_v2_ready ] || {
-    echo "feed incomplete (waiting for loader assembly fix)"; return 0; }
+  # the parallelized loader assembly this stage re-measures is in HEAD
+  # (data/loader.py device_prefetch uploader); no readiness marker needed
   canary || { echo "canary failed; skipping feed"; return 1; }
   supervise runs/r5logs/feed.log 600 \
     timeout 7200 python -u tools/feed_bench.py \
