@@ -1,6 +1,7 @@
 # Developer entry points. `scripts/setup.sh` chains native + data + test.
 
-.PHONY: native data test test-full verify-faults verify-serving bench smoke clean
+.PHONY: native data test test-full verify verify-faults verify-serving \
+    verify-resilience bench smoke clean
 
 native:
 	$(MAKE) -C native
@@ -22,6 +23,11 @@ verify-faults:  # crash-safety + fault-injection suite, slow kill-and-resume inc
 verify-serving:  # batching engine: bucket bitwise parity, zero-recompile, lifecycle
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
 	    tests/test_serving_engine.py -q
+
+verify-resilience:  # fault-injected serving: restart+replay, poison isolation, breaker, shedding
+	JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q
+
+verify: verify-faults verify-serving verify-resilience  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -33,6 +33,14 @@ Extra modes (round-2 verdict items 2 and 5), each also one JSON line:
                    occupancy, bucket-hit histogram, p50/p99 request
                    latency — the production serving path, vs
                    --mode inference's pre-staged hardware ceiling.
+  --mode serving --faults [SPEC]
+                   the chaos run: same concurrent-submitter workload, but
+                   through the resilience supervisor with DEEPGO_FAULTS
+                   injected (default spec kills the dispatcher and throws
+                   transient forward faults). Reports GOODPUT — boards
+                   that actually resolved per second — plus the restart /
+                   shed / poison counters, so the cost of surviving
+                   failure is measured rather than asserted.
 """
 
 from __future__ import annotations
@@ -463,7 +471,13 @@ def _bench_latency(on_tpu: bool) -> dict:
     }
 
 
-def _bench_serving(on_tpu: bool) -> dict:
+# the default chaos plan: one dispatcher kill mid-run plus a burst of
+# transient forward faults — the two failure shapes the supervisor's
+# restart and poison-isolation paths absorb
+DEFAULT_CHAOS_FAULTS = "serving_dispatch:fail@3,serving_forward:transient@2"
+
+
+def _bench_serving(on_tpu: bool, faults_spec: str | None = None) -> dict:
     """Micro-batching engine throughput under concurrent submitters.
 
     Unlike --mode inference (one giant pre-staged batch through a scan —
@@ -473,12 +487,20 @@ def _bench_serving(on_tpu: bool) -> dict:
     ladder, and the engine's own counters report boards/sec, batch
     occupancy, bucket-hit histogram, and p50/p99 request latency. The
     gap between this number and --mode inference is the engine's
-    coalescing + host overhead, measured rather than guessed."""
+    coalescing + host overhead, measured rather than guessed.
+
+    ``faults_spec`` (--faults) turns this into the chaos run: the plan is
+    installed via deepgo_tpu.utils.faults, the engine runs under the
+    resilience supervisor, and the headline value becomes GOODPUT —
+    requests that resolved successfully per second — with every typed
+    failure outcome (shed / poisoned / other) counted, not crashed on."""
     import jax
 
     from deepgo_tpu.models import policy_cnn
     from deepgo_tpu.models.serving import make_log_prob_fn
-    from deepgo_tpu.serving import EngineConfig, InferenceEngine
+    from deepgo_tpu.serving import (CircuitOpen, EngineConfig,
+                                    EngineOverloaded, InferenceEngine,
+                                    PoisonedRequest, SupervisedEngine)
 
     if on_tpu:
         name, submitters, per_thread = "full", 32, 512
@@ -488,9 +510,17 @@ def _bench_serving(on_tpu: bool) -> dict:
         buckets = (1, 8, 32)
     cfg = policy_cnn.CONFIGS[name]
     params = policy_cnn.init(jax.random.key(0), cfg)
-    engine = InferenceEngine(
-        make_log_prob_fn(cfg), params,
-        EngineConfig(buckets=buckets, max_wait_ms=2.0), name="bench")
+    forward = make_log_prob_fn(cfg)
+    ecfg = EngineConfig(buckets=buckets, max_wait_ms=2.0)
+    if faults_spec:
+        from deepgo_tpu.utils import faults as faults_mod
+
+        faults_mod.install(faults_spec)
+        engine = SupervisedEngine(
+            lambda: InferenceEngine(forward, params, ecfg, name="bench"),
+            name="bench")
+    else:
+        engine = InferenceEngine(forward, params, ecfg, name="bench")
     engine.warmup()
 
     import threading
@@ -498,14 +528,27 @@ def _bench_serving(on_tpu: bool) -> dict:
     rng = np.random.default_rng(0)
     packed, player, rank = _rand_batch(rng, (submitters,))
     errors = []
+    lock = threading.Lock()
+    outcomes = {"ok": 0, "shed": 0, "poisoned": 0, "failed": 0}
 
     def submitter(i: int) -> None:
-        try:
-            for _ in range(per_thread):
+        for _ in range(per_thread):
+            try:
                 engine.submit(packed[i], int(player[i]),
                               int(rank[i])).result()
-        except BaseException as e:  # noqa: BLE001 — reported in the JSON
-            errors.append(f"{type(e).__name__}: {e}")
+                kind = "ok"
+            except (EngineOverloaded, CircuitOpen):
+                kind = "shed"
+            except PoisonedRequest:
+                kind = "poisoned"
+            except BaseException as e:  # noqa: BLE001 — reported in the JSON
+                if faults_spec is None:
+                    errors.append(f"{type(e).__name__}: {e}")
+                    return
+                errors.append(f"{type(e).__name__}: {e}")
+                kind = "failed"
+            with lock:
+                outcomes[kind] += 1
 
     t0 = time.time()
     threads = [threading.Thread(target=submitter, args=(i,))
@@ -516,13 +559,19 @@ def _bench_serving(on_tpu: bool) -> dict:
         t.join()
     dt = time.time() - t0
     stats = engine.stats()
+    health = engine.health() if faults_spec else None
     engine.close()
     boards = submitters * per_thread
+    goodput = outcomes["ok"] / dt
     result = {
-        "metric": "serving_engine_boards_per_sec_per_chip",
-        "value": round(boards / dt, 1),
+        "metric": ("serving_engine_goodput_under_faults_boards_per_sec"
+                   if faults_spec else
+                   "serving_engine_boards_per_sec_per_chip"),
+        "value": round(goodput if faults_spec else boards / dt, 1),
         "unit": "boards/sec",
-        "vs_baseline": round(boards / dt / BASELINE_BOARDS_PER_SEC, 3),
+        "vs_baseline": round(
+            (goodput if faults_spec else boards / dt)
+            / BASELINE_BOARDS_PER_SEC, 3),
         "model": f"{name} policy CNN via micro-batching engine",
         "submitters": submitters,
         "requests_per_submitter": per_thread,
@@ -531,6 +580,17 @@ def _bench_serving(on_tpu: bool) -> dict:
         "p50_ms": stats["p50_ms"],
         "p99_ms": stats["p99_ms"],
     }
+    if faults_spec:
+        result.update({
+            "faults": faults_spec,
+            "submitted": boards,
+            "outcomes": outcomes,
+            "restarts": health["restarts"],
+            "shed_overload": health["shed_overload"],
+            "shed_breaker": health["shed_breaker"],
+            "poisoned": health["poisoned"],
+            "breaker": health["breaker"]["state"],
+        })
     if errors:
         result["error"] = "; ".join(sorted(set(errors))[:3])
     return result
@@ -543,7 +603,16 @@ def main() -> None:
     ap.add_argument("--mode", default="inference",
                     choices=["inference", "train", "latency", "large",
                              "serving"])
+    ap.add_argument("--faults", nargs="?", const=DEFAULT_CHAOS_FAULTS,
+                    default=None, metavar="SPEC",
+                    help="(--mode serving only) chaos run: install this "
+                         "DEEPGO_FAULTS spec (default: "
+                         f"'{DEFAULT_CHAOS_FAULTS}'), run the engine "
+                         "under the resilience supervisor, and report "
+                         "goodput + restart/shed/poison counters")
     args = ap.parse_args()
+    if args.faults is not None and args.mode != "serving":
+        ap.error("--faults only applies to --mode serving")
 
     _preflight_probe(args.mode)
     watchdog = _arm_watchdog(args.mode)
@@ -563,9 +632,12 @@ def main() -> None:
     on_tpu = device.platform != "cpu"
 
     if args.mode != "inference":
-        fn = {"train": _bench_train, "latency": _bench_latency,
-              "large": _bench_large, "serving": _bench_serving}[args.mode]
-        result = fn(on_tpu)
+        if args.mode == "serving":
+            result = _bench_serving(on_tpu, args.faults)
+        else:
+            fn = {"train": _bench_train, "latency": _bench_latency,
+                  "large": _bench_large}[args.mode]
+            result = fn(on_tpu)
         result["device"] = str(device)
         watchdog.disarm()
         if on_tpu and result.get("value"):

@@ -623,19 +623,26 @@ class Value2PlyAgent(ValueSearchAgent):
                             tie_scale=1e-4)
 
 
-def _policy_engine_for(params, cfg, use_engine: bool):
+def _policy_engine_for(params, cfg, use_engine):
     """The shared policy engine for this checkpoint, or None. Agents built
     from the same params then coalesce their per-ply forwards into the
-    same micro-batched dispatches (serving.shared_policy_engine)."""
+    same micro-batched dispatches (serving.shared_policy_engine).
+    ``use_engine="supervised"`` puts the shared engine under the
+    resilience supervisor (serving.SupervisedEngine) so agents ride
+    through dispatcher restarts untouched."""
     if not use_engine:
         return None
     from .serving import shared_policy_engine
 
-    return shared_policy_engine(params, cfg)
+    return shared_policy_engine(params, cfg,
+                                supervised=use_engine == "supervised")
 
 
 def _make_agent(spec: str, seed: int, temperature: float = 0.0,
-                rank: int = 9, use_engine: bool = False) -> Agent:
+                rank: int = 9, use_engine=False) -> Agent:
+    """``use_engine``: False (direct ladder path), True (shared
+    micro-batching engine), or "supervised" (shared engine under the
+    resilience supervisor)."""
     if spec == "random":
         return RandomAgent()
     if spec == "heuristic":
@@ -682,7 +689,8 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         if use_engine:
             from .serving import shared_value_engine
 
-            value_engine = shared_value_engine(vparams, vcfg)
+            value_engine = shared_value_engine(
+                vparams, vcfg, supervised=use_engine == "supervised")
         return cls(params, cfg, vparams, vcfg, rank=rank,
                    engine=_policy_engine_for(params, cfg, use_engine),
                    value_engine=value_engine)

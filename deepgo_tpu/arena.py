@@ -20,10 +20,11 @@ from .agents import (  # noqa: F401
 from .match import main, play_match  # noqa: F401
 from .selfplay import GameState  # noqa: F401
 # serving-engine surface, so arena-level tools can opt their agents into
-# the shared micro-batching evaluator without a second import path
+# the shared micro-batching evaluator (and its resilience supervisor)
+# without a second import path
 from .serving import (  # noqa: F401
-    EngineConfig, InferenceEngine, close_shared_engines,
-    shared_policy_engine, shared_value_engine,
+    EngineConfig, InferenceEngine, SupervisedEngine, SupervisorConfig,
+    close_shared_engines, shared_policy_engine, shared_value_engine,
 )
 
 if __name__ == "__main__":

@@ -155,7 +155,9 @@ def main(argv=None) -> None:
     # shows up in --help output
     sub.add_parser("selfplay", help="engine-driven batched self-play "
                                     "(flags forward to deepgo_tpu.selfplay, "
-                                    "e.g. --games 32 --max-wait-ms 2)")
+                                    "e.g. --games 32 --max-wait-ms 2; "
+                                    "--supervised runs the engine under "
+                                    "the resilience supervisor)")
 
     args = ap.parse_args(argv)
     honor_platform_env()

@@ -118,13 +118,17 @@ class GoDataset:
         (EIO on a cold page, the loader_io fault point) reaches training,
         so it runs under the bounded-backoff retry policy: transient
         OSErrors are absorbed with a logged note, anything persistent
-        propagates after the attempts run out."""
+        propagates after the attempts run out. Full jitter because this
+        site retries from EVERY loader thread at once when shared storage
+        blips — deterministic delays would re-synchronize the herd into
+        periodic bursts against the same recovering mount."""
         def gather():
             faults.check("loader_io")
             return self.planes[indices], self.meta[indices]
 
         # (B, 9, 19, 19) uint8 copy out of the memmap
-        packed, meta = retry_with_backoff(gather, attempts=5, base_delay=0.05)
+        packed, meta = retry_with_backoff(gather, attempts=5, base_delay=0.05,
+                                          jitter=True)
         player = meta[:, M_PLAYER]
         rank = np.where(player == 1, meta[:, M_BLACK_RANK], meta[:, M_WHITE_RANK])
         target = meta[:, M_X] * BOARD_SIZE + meta[:, M_Y]

@@ -214,15 +214,17 @@ class AsyncLoader:
                 threading.Thread(
                     target=self._worker,
                     args=(np.random.default_rng(s),),
+                    name=f"loader-worker-{i}",
                     daemon=True,
                 )
-                for s in worker_seeds
+                for i, s in enumerate(worker_seeds)
             ]
             for t in self._threads:
                 t.start()
             if device_prefetch > 0:
                 self._dev_queue = queue.Queue(maxsize=device_prefetch)
                 self._uploader = threading.Thread(target=self._upload_loop,
+                                                  name="loader-uploader",
                                                   daemon=True)
                 self._threads.append(self._uploader)
                 self._uploader.start()
@@ -337,11 +339,55 @@ class AsyncLoader:
         while True:
             yield self.get()
 
-    def close(self) -> None:
-        if self.num_threads > 0:
-            self._stop.set()
-            for t in self._threads:
-                t.join(timeout=2.0)
+    def _drain_dev_queue(self) -> None:
+        """Discard everything staged on the device queue. An uploader
+        blocked in ``_dev_queue.put()`` at close time can only exit once
+        a slot frees up — nobody is consuming anymore, so close() must
+        consume for it."""
+        if self._dev_queue is None:
+            return
+        while True:
+            try:
+                self._dev_queue.get_nowait()
+            except queue.Empty:
+                return
+
+    def close(self, timeout: float = 2.0) -> None:
+        """Stop and join the thread pool.
+
+        Joins used to time out silently, leaking an uploader blocked
+        inside ``jax.device_put`` (the relay tunnel can block it for
+        minutes) while close() returned as if the shutdown were clean.
+        Now the device queue is drained while joining — unblocking an
+        uploader parked in ``put()`` — and any thread that still won't
+        exit is reported LOUDLY on stderr: a leaked thread is a fact the
+        operator must see, not a secret. Leaked threads are daemons, so
+        they die with the process either way."""
+        if self.num_threads <= 0:
+            return
+        import sys
+        import time
+
+        self._stop.set()
+        self._drain_dev_queue()
+        for t in self._threads:
+            deadline = time.monotonic() + timeout
+            while t.is_alive():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                t.join(timeout=min(0.1, remaining))
+                # keep the exit path clear: the uploader may have staged
+                # another batch between drains
+                self._drain_dev_queue()
+        leaked = [t.name for t in self._threads if t.is_alive()]
+        if leaked:
+            print(
+                f"AsyncLoader.close: {len(leaked)} thread(s) still alive "
+                f"after {timeout}s: {', '.join(leaked)} — likely blocked "
+                "inside jax.device_put (wedged device/relay). Leaking "
+                "them; daemon threads die with the process.",
+                file=sys.stderr, flush=True)
 
     def __enter__(self):
         return self

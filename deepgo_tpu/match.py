@@ -177,22 +177,29 @@ def main(argv=None) -> None:
                          "(deepgo_tpu.serving): both sides of a match "
                          "built from the same checkpoint coalesce into "
                          "the same padded dispatches (docs/serving.md)")
+    ap.add_argument("--supervised", action="store_true",
+                    help="like --engine, but the shared engines run under "
+                         "the resilience supervisor: dispatcher-death "
+                         "auto-restart with replay, batch-poison "
+                         "isolation, circuit breaker, deadline shedding "
+                         "(docs/robustness.md)")
     args = ap.parse_args(argv)
 
     from .utils import honor_platform_env
 
     honor_platform_env()
+    use_engine = "supervised" if args.supervised else args.engine
     agent_a = _make_agent(args.a, args.seed, args.temperature, args.rank,
-                          use_engine=args.engine)
+                          use_engine=use_engine)
     agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank,
-                          use_engine=args.engine)
+                          use_engine=use_engine)
     try:
         games, scores, stats = play_match(
             agent_a, agent_b, n_games=args.games, komi=args.komi,
             max_moves=args.max_moves, seed=args.seed,
             opening_plies=args.opening_plies)
     finally:
-        if args.engine:
+        if use_engine:
             from .serving import close_shared_engines
 
             close_shared_engines()

@@ -211,7 +211,8 @@ def select_from_log_probs(row: np.ndarray, temperature: float,
 def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
               max_moves: int = 361, temperature: float = 0.0,
               pass_threshold: float = 1e-4, rank: int = 9, seed: int = 0,
-              engine=None, max_wait_ms: float = 2.0):
+              engine=None, max_wait_ms: float = 2.0,
+              supervised: bool = False):
     """Play n_games to completion; returns (games, stats).
 
     Inference rides the micro-batching engine (deepgo_tpu.serving): each
@@ -223,15 +224,25 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
     sharing the engine (arena agents, an eval frontend) ride the same
     saturated dispatches. Pass ``engine`` to share one; by default the
     run builds a private engine over a ladder trimmed to ``n_games``,
-    warms every rung, and closes it on exit. ``stats["engine"]`` carries
-    the engine's occupancy/latency/bucket counters.
+    warms every rung, and closes it on exit. ``supervised=True`` puts the
+    private engine under the resilience supervisor (auto-restart, poison
+    isolation, breaker, deadline shedding — docs/robustness.md): games
+    then ride through dispatcher deaths untouched, with bit-identical
+    results (the forward is pure, replay is idempotent).
+    ``stats["engine"]`` carries the engine's occupancy/latency/bucket
+    counters (plus the supervisor's restart/shed/poison counters when
+    supervised).
     """
     own_engine = engine is None
     if own_engine:
-        engine = policy_engine(
-            params, cfg,
-            config=EngineConfig(buckets=ladder_for(n_games).buckets,
-                                max_wait_ms=max_wait_ms))
+        ecfg = EngineConfig(buckets=ladder_for(n_games).buckets,
+                            max_wait_ms=max_wait_ms)
+        if supervised:
+            from .serving import supervised_policy_engine
+
+            engine = supervised_policy_engine(params, cfg, config=ecfg)
+        else:
+            engine = policy_engine(params, cfg, config=ecfg)
         engine.warmup()
     rng = np.random.default_rng(seed)
     games = [GameState() for _ in range(n_games)]
@@ -305,6 +316,12 @@ def main(argv=None) -> None:
                     help="engine coalescing window: how long the "
                          "dispatcher waits for more submitters before "
                          "padding and dispatching (docs/serving.md)")
+    ap.add_argument("--supervised", action="store_true",
+                    help="run the engine under the resilience supervisor: "
+                         "dispatcher-death auto-restart with request "
+                         "replay, batch-poison isolation, circuit "
+                         "breaker, deadline-aware shedding "
+                         "(docs/robustness.md)")
     args = ap.parse_args(argv)
 
     from .utils import honor_platform_env
@@ -322,7 +339,8 @@ def main(argv=None) -> None:
     games, stats = self_play(params, cfg, n_games=args.games,
                              max_moves=args.max_moves,
                              temperature=args.temperature, seed=args.seed,
-                             max_wait_ms=args.max_wait_ms)
+                             max_wait_ms=args.max_wait_ms,
+                             supervised=args.supervised)
     print({k: round(v, 2) if isinstance(v, float) else v
            for k, v in stats.items()})
 

@@ -10,6 +10,12 @@ The layer every inference workload calls into (ROADMAP north star:
     get futures; a dispatcher coalesces, pads, runs one device dispatch,
     scatters rows back. Bounded queue, per-request timeouts, engine
     metrics (p50/p99, occupancy, bucket histogram, boards/sec).
+  * resilience.py / supervisor.py — the failure-as-steady-state layer:
+    ``SupervisedEngine`` wraps an engine factory with dispatcher-death
+    auto-restart (bounded exponential backoff + full jitter, in-flight
+    requests replayed), batch-poison isolation (solo-lane bisection +
+    atomic quarantine dump), a closed/open/half-open circuit breaker,
+    and deadline-aware admission control (docs/robustness.md).
 
 Factories below wire the engine to the models; ``shared_policy_engine`` /
 ``shared_value_engine`` memoize per (params, config) so mixed workloads —
@@ -21,8 +27,13 @@ from __future__ import annotations
 
 from .buckets import (DEFAULT_BUCKETS, BucketLadder,  # noqa: F401
                       bucketed_forward)
-from .engine import (EngineBusy, EngineClosed, EngineConfig,  # noqa: F401
-                     EngineError, InferenceEngine)
+from .engine import (BatchDispatchError, EngineBusy,  # noqa: F401
+                     EngineClosed, EngineConfig, EngineError,
+                     InferenceEngine)
+from .resilience import (CircuitBreaker, CircuitOpen,  # noqa: F401
+                         EngineOverloaded, PoisonedRequest,
+                         RestartsExhausted, full_jitter_delay)
+from .supervisor import SupervisedEngine, SupervisorConfig  # noqa: F401
 
 
 def ladder_for(n_games: int, buckets=DEFAULT_BUCKETS) -> BucketLadder:
@@ -55,32 +66,70 @@ def value_engine(params, cfg, config: EngineConfig | None = None,
                            name=name, metrics=metrics)
 
 
+def supervised_policy_engine(params, cfg,
+                             config: EngineConfig | None = None,
+                             supervisor: SupervisorConfig | None = None,
+                             expand_backend: str = "xla", metrics=None,
+                             name: str = "policy") -> SupervisedEngine:
+    """Resilient engine over the policy forward: an InferenceEngine
+    factory under a SupervisedEngine (auto-restart, poison isolation,
+    breaker, deadline shedding). The jitted forward is built ONCE and
+    closed over, so a restart reuses the warm jit cache — replayed
+    requests never recompile."""
+    from ..models.serving import make_log_prob_fn
+
+    forward = make_log_prob_fn(cfg, expand_backend)
+    return SupervisedEngine(
+        lambda: InferenceEngine(forward, params, config=config, name=name,
+                                metrics=metrics),
+        config=supervisor, name=name, metrics=metrics)
+
+
+def supervised_value_engine(params, cfg,
+                            config: EngineConfig | None = None,
+                            supervisor: SupervisorConfig | None = None,
+                            metrics=None,
+                            name: str = "value") -> SupervisedEngine:
+    """Resilient engine over the value forward (see
+    supervised_policy_engine)."""
+    from ..models.serving import make_value_fn
+
+    forward = make_value_fn(cfg)
+    return SupervisedEngine(
+        lambda: InferenceEngine(forward, params, config=config, name=name,
+                                metrics=metrics),
+        config=supervisor, name=name, metrics=metrics)
+
+
 # One engine per live (params, model config, engine config): agents built
 # from the same checkpoint — a policy player and the value searcher's
 # prior, both sides of a self-match — coalesce into the same dispatches.
 _SHARED: dict[tuple, InferenceEngine] = {}
 
 
-def _shared(kind: str, factory, params, cfg,
-            config: EngineConfig | None) -> InferenceEngine:
-    key = (kind, id(params), cfg, config)
+def _shared(kind: str, factory, params, cfg, config: EngineConfig | None,
+            supervised: bool):
+    key = (kind, supervised, id(params), cfg, config)
     engine = _SHARED.get(key)
-    if engine is None or engine._closing.is_set():
+    if (engine is None or engine._closing.is_set()
+            or getattr(engine, "_failed", None) is not None):
         engine = _SHARED[key] = factory(params, cfg, config=config,
                                         name=f"shared-{kind}")
     return engine
 
 
-def shared_policy_engine(params, cfg,
-                         config: EngineConfig | None = None
-                         ) -> InferenceEngine:
-    return _shared("policy", policy_engine, params, cfg, config)
+def shared_policy_engine(params, cfg, config: EngineConfig | None = None,
+                         supervised: bool = False):
+    return _shared("policy",
+                   supervised_policy_engine if supervised else policy_engine,
+                   params, cfg, config, supervised)
 
 
-def shared_value_engine(params, cfg,
-                        config: EngineConfig | None = None
-                        ) -> InferenceEngine:
-    return _shared("value", value_engine, params, cfg, config)
+def shared_value_engine(params, cfg, config: EngineConfig | None = None,
+                        supervised: bool = False):
+    return _shared("value",
+                   supervised_value_engine if supervised else value_engine,
+                   params, cfg, config, supervised)
 
 
 def close_shared_engines() -> None:

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+import sys
 import threading
 import time
 from collections import deque
@@ -30,6 +31,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..utils import faults
 from .buckets import DEFAULT_BUCKETS, BucketLadder
 
 
@@ -43,6 +45,23 @@ class EngineClosed(EngineError):
 
 class EngineBusy(EngineError):
     """Non-blocking submit() against a full request queue (backpressure)."""
+
+
+class BatchDispatchError(EngineError):
+    """One coalesced dispatch failed inside the forward.
+
+    Fails only the batch that rode the broken dispatch — the dispatcher
+    survives, so one bad request cannot permanently kill the engine for
+    every later submitter. Carries ``batch_size`` (live requests in the
+    failed dispatch) so the resilience layer (serving/supervisor.py) can
+    tell group failure (retry members individually: a neighbor may be
+    poison) from lone failure (this request fails on its own). The
+    underlying forward exception rides as ``__cause__``.
+    """
+
+    def __init__(self, message: str, batch_size: int):
+        super().__init__(message)
+        self.batch_size = batch_size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,15 +80,17 @@ class EngineConfig:
 
 
 class _Request:
-    __slots__ = ("packed", "player", "rank", "future", "t_submit", "deadline")
+    __slots__ = ("packed", "player", "rank", "future", "t_submit", "deadline",
+                 "solo")
 
-    def __init__(self, packed, player, rank, deadline):
+    def __init__(self, packed, player, rank, deadline, solo=False):
         self.packed = packed
         self.player = player
         self.rank = rank
         self.future: Future = Future()
         self.t_submit = time.monotonic()
         self.deadline = deadline
+        self.solo = solo
 
 
 class InferenceEngine:
@@ -95,12 +116,23 @@ class InferenceEngine:
         self._error: BaseException | None = None
         self._lock = threading.Lock()
         self._latencies: deque[float] = deque(maxlen=self.config.latency_window)
+        # forward-only durations of recent successful dispatches: the
+        # supervisor's admission control estimates queue wait from their
+        # p50 (a small window keeps the estimate current under load shifts)
+        self._dispatch_secs: deque[float] = deque(maxlen=64)
+        # solo lane: isolation retries from the resilience layer dispatch
+        # strictly alone (never coalesced), so a retried request's failure
+        # is attributable to IT. Internal — bypasses the bounded queue;
+        # membership is capped by the batch that failed.
+        self._solo: deque[_Request] = deque()
         self._bucket_hits: dict[int, int] = {}
         self._dispatches = 0
+        self._dispatch_failures = 0
         self._boards = 0
         self._padded_boards = 0
         self._timeouts = 0
         self._warm_shapes = 0
+        self._join_timed_out = False
         self._t_start = time.monotonic()
         self._thread = threading.Thread(
             target=self._dispatch_loop, name=f"serving-{name}", daemon=True)
@@ -146,6 +178,16 @@ class InferenceEngine:
             self._cancel.set()
         self._closing.set()
         self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # a wedged dispatcher (blocked inside a device claim holding
+            # the GIL) must be VISIBLE: record it in stats() and say so on
+            # stderr instead of returning as if the shutdown were clean
+            self._join_timed_out = True
+            print(
+                f"InferenceEngine[{self.name}] dispatcher did not exit "
+                f"within {timeout}s at close; thread leaked (likely wedged "
+                "inside the forward / device claim)",
+                file=sys.stderr, flush=True)
         # belt and braces: anything still queued after the join (thread
         # died, join timed out) must not strand its waiters
         self._fail_pending(EngineClosed(
@@ -163,7 +205,8 @@ class InferenceEngine:
     # -- submission --------------------------------------------------------
 
     def submit(self, packed: np.ndarray, player: int, rank: int,
-               timeout_s: float | None = None, block: bool = True) -> Future:
+               timeout_s: float | None = None, block: bool = True,
+               solo: bool = False) -> Future:
         """Queue one board; returns a Future resolving to its result row.
 
         ``timeout_s`` (default: config.timeout_s) bounds queue-to-result
@@ -171,11 +214,17 @@ class InferenceEngine:
         occupying a dispatch. With ``block=False`` a full queue raises
         EngineBusy immediately; blocking submits wait for space but keep
         re-checking engine liveness so a dead dispatcher can't strand
-        them."""
+        them. ``solo=True`` routes the request through the isolation lane:
+        it dispatches strictly alone (the supervisor's batch-poison
+        bisection), skipping the bounded queue."""
         self._check_alive()
         timeout_s = self.config.timeout_s if timeout_s is None else timeout_s
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
-        req = _Request(np.asarray(packed), int(player), int(rank), deadline)
+        req = _Request(np.asarray(packed), int(player), int(rank), deadline,
+                       solo=solo)
+        if solo:
+            self._solo.append(req)
+            return req.future
         while True:
             try:
                 self._queue.put(req, block=block, timeout=0.1)
@@ -205,13 +254,17 @@ class InferenceEngine:
     def _collect(self) -> list[_Request] | None:
         """One coalescing window: block for the first request, then gather
         until the ladder's top rung fills or ``max_wait_ms`` elapses.
-        Returns None when closing and the queue is empty."""
+        Solo requests (the isolation lane) preempt the window and dispatch
+        strictly alone. Returns None when closing and everything is
+        empty."""
         while True:
+            if self._solo:
+                return [self._solo.popleft()]
             try:
                 first = self._queue.get(timeout=0.05)
                 break
             except queue.Empty:
-                if self._closing.is_set():
+                if self._closing.is_set() and not self._solo:
                     return None
         batch = [first]
         t_end = time.monotonic() + self.config.max_wait_ms / 1000.0
@@ -246,7 +299,26 @@ class InferenceEngine:
             np.stack([r.packed for r in live]),
             np.array([r.player for r in live], dtype=np.int32),
             np.array([r.rank for r in live], dtype=np.int32), bucket)
-        out = np.asarray(self._forward(self._params, packed, players, ranks))
+        t_fwd = time.monotonic()
+        try:
+            faults.check("serving_forward")
+            out = np.asarray(
+                self._forward(self._params, packed, players, ranks))
+        except BaseException as e:  # noqa: BLE001 — typed onto the futures
+            # contain the blast radius to THIS batch: its futures fail with
+            # a typed wrapper (cause attached), the dispatcher keeps
+            # serving everyone else. The supervisor bisects the batch by
+            # retrying members through the solo lane.
+            err = BatchDispatchError(
+                f"dispatch of {n} request(s) failed in "
+                f"InferenceEngine[{self.name}]: {e!r}", n)
+            err.__cause__ = e
+            with self._lock:
+                self._dispatch_failures += 1
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(err)
+            return
         t_done = time.monotonic()
         for i, r in enumerate(live):
             r.future.set_result(out[i])
@@ -256,6 +328,7 @@ class InferenceEngine:
             self._padded_boards += bucket
             self._bucket_hits[bucket] = self._bucket_hits.get(bucket, 0) + 1
             self._latencies.extend(t_done - r.t_submit for r in live)
+            self._dispatch_secs.append(t_done - t_fwd)
             write_metrics = (
                 self._metrics is not None
                 and self._dispatches % self.config.metrics_interval == 0)
@@ -263,6 +336,13 @@ class InferenceEngine:
             self._metrics.write("serving", engine=self.name, **self.stats())
 
     def _fail_pending(self, exc: BaseException) -> None:
+        while self._solo:
+            try:
+                req = self._solo.popleft()
+            except IndexError:  # pragma: no cover — concurrent drain
+                break
+            if not req.future.done():
+                req.future.set_exception(exc)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -282,6 +362,11 @@ class InferenceEngine:
                 batch = self._collect()
                 if batch is None:
                     return
+                # dispatcher-death fault point: fires OUTSIDE the per-batch
+                # containment, so an injected fault here exercises the real
+                # thread-death path (stashed error, failed futures, next
+                # submit() raises) that the supervisor's restart absorbs
+                faults.check("serving_dispatch")
                 self._dispatch(batch)
         except BaseException as e:  # noqa: BLE001 — surfaced via submit()
             # AsyncLoader._worker's contract: stash the error, fail every
@@ -296,6 +381,20 @@ class InferenceEngine:
             self._fail_pending(e)
 
     # -- observability -----------------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Requests waiting in the bounded queue right now (approximate —
+        the dispatcher drains concurrently)."""
+        return self._queue.qsize()
+
+    def dispatch_p50_s(self) -> float | None:
+        """Rolling median forward duration of recent successful dispatches
+        (seconds), or None before the first one. The admission-control
+        input: estimated queue wait = p50 x pending dispatch windows."""
+        with self._lock:
+            if not self._dispatch_secs:
+                return None
+            return float(np.median(self._dispatch_secs))
 
     def stats(self) -> dict:
         """Snapshot of the engine counters: request p50/p99 latency (ms,
@@ -319,5 +418,7 @@ class InferenceEngine:
                 "p99_ms": round(float(np.percentile(lat, 99)) * 1000, 3)
                 if lat.size else None,
                 "timeouts": self._timeouts,
+                "dispatch_failures": self._dispatch_failures,
+                "dispatcher_wedged": self._join_timed_out,
                 "warm_shapes": self._warm_shapes,
             }

@@ -7,12 +7,19 @@ parsed from the ``DEEPGO_FAULTS`` environment variable (or installed
 programmatically / via ``ExperimentConfig.faults``) and consulted at named
 *fault points* threaded through the codebase:
 
-  site          where it fires
-  ----          ---------------
-  ckpt_write    inside the atomic checkpoint write (checkpoint.save_checkpoint)
-  loader_io     the memmap gather in GoDataset.batch_at
-  train_step    just before a training step executes (experiment._train)
-  kill          after a training step completes, keyed on the step number
+  site             where it fires
+  ----             ---------------
+  ckpt_write       inside the atomic checkpoint write (checkpoint.save_checkpoint)
+  loader_io        the memmap gather in GoDataset.batch_at
+  train_step       just before a training step executes (experiment._train)
+  kill             after a training step completes, keyed on the step number
+  serving_dispatch the serving dispatcher loop, once per coalescing window,
+                   OUTSIDE the per-batch containment — an injected fault
+                   here kills the dispatcher thread (the death the
+                   SupervisedEngine restart absorbs)
+  serving_forward  inside the serving dispatch, alongside the jitted
+                   forward — an injected fault here fails ONE coalesced
+                   batch (BatchDispatchError; the poison-isolation path)
 
 Grammar (comma-separated ``site:kind@arg`` specs):
 

@@ -9,10 +9,20 @@ the auto-resume path both prefer a dead process to a wedged one).
 
 Only exceptions in ``retry_on`` (default: ``OSError``) are retried; any
 other exception is a logic error and propagates immediately.
+
+``jitter=True`` opts into full-jitter backoff: each sleep is drawn
+U(0, d) where d is the deterministic exponential delay. When MANY callers
+hit the same fault at the same instant — every selfplay submitter retrying
+the same revived engine, every loader thread retrying the same flaky
+mount — deterministic delays re-synchronize the herd into periodic
+thundering bursts; full jitter decorrelates them while the exponential
+envelope still bounds the worst case. Single-caller paths can keep the
+deterministic schedule (it's easier to reason about in logs).
 """
 
 from __future__ import annotations
 
+import random
 import sys
 import time
 
@@ -27,15 +37,21 @@ def retry_with_backoff(
     retry_on: tuple = (OSError,),
     on_retry=None,
     sleep=time.sleep,
+    jitter: bool = False,
+    rng: random.Random | None = None,
 ):
     """Call ``fn()``; retry ``retry_on`` failures up to ``attempts`` total
     tries, sleeping ``base_delay * factor**k`` (capped at ``max_delay``)
-    between tries. The final failure re-raises. ``on_retry(exc, attempt,
-    delay)`` observes each absorbed failure (default: a stderr note, so
-    absorbed faults stay visible in run logs); ``sleep`` is injectable for
-    tests."""
+    between tries — or, with ``jitter=True``, a uniform draw from [0,
+    that envelope] (full jitter; ``rng`` is injectable for deterministic
+    tests). The final failure re-raises. ``on_retry(exc, attempt, delay)``
+    observes each absorbed failure with the ACTUAL delay slept (default: a
+    stderr note, so absorbed faults stay visible in run logs); ``sleep``
+    is injectable for tests."""
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
+    if jitter and rng is None:
+        rng = random.Random()
     delay = base_delay
     for attempt in range(1, attempts + 1):
         try:
@@ -43,14 +59,15 @@ def retry_with_backoff(
         except retry_on as e:
             if attempt == attempts:
                 raise
+            actual = rng.uniform(0.0, delay) if jitter else delay
             if on_retry is not None:
-                on_retry(e, attempt, delay)
+                on_retry(e, attempt, actual)
             else:
                 print(
                     f"transient fault ({e}); retry {attempt}/{attempts - 1} "
-                    f"in {delay:.2f}s",
+                    f"in {actual:.2f}s",
                     file=sys.stderr,
                     flush=True,
                 )
-            sleep(delay)
+            sleep(actual)
             delay = min(delay * factor, max_delay)

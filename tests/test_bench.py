@@ -10,7 +10,7 @@ import pytest
 from tests.conftest import REPO_ROOT
 
 
-def _run_bench(extra_env, timeout):
+def _run_bench(extra_env, timeout, args=()):
     # pin BENCH_WATCHDOG so an ambient =0 can't disable the tested
     # mechanism, and point BENCH_LAST_GOOD away from the committed
     # last-good table (failure tests assert the nothing-ever-measured
@@ -20,7 +20,7 @@ def _run_bench(extra_env, timeout):
                BENCH_LAST_GOOD="/nonexistent/bench_last_good.json")
     env.update(extra_env)
     return subprocess.run(
-        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), *args],
         capture_output=True, text=True, timeout=timeout, env=env,
     )
 
@@ -106,6 +106,31 @@ def test_committed_last_good_table_is_wellformed():
         assert entry["value"] > 0
         assert entry["timestamp"] and entry["git_sha"]
         assert "TPU" in entry["device"]
+
+
+def test_serving_chaos_bench_contract():
+    # the chaos run: --mode serving --faults must survive the injected
+    # dispatcher kill + transient forwards (via the supervisor), emit one
+    # schema-compliant JSON line whose headline value is GOODPUT, and
+    # carry the resilience counters next to it
+    proc = _run_bench({"BENCH_PREFLIGHT": "0", "BENCH_WATCHDOG": "0"},
+                      timeout=300, args=["--mode", "serving", "--faults"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    record = json.loads(lines[0])
+    assert record["metric"] == \
+        "serving_engine_goodput_under_faults_boards_per_sec"
+    assert record["value"] > 0
+    assert record["restarts"] >= 1  # the dispatcher kill really fired
+    assert record["submitted"] == sum(record["outcomes"].values())
+    assert "faults" in record and "poisoned" in record and "breaker" in record
+
+
+def test_faults_flag_requires_serving_mode():
+    proc = _run_bench({}, timeout=120, args=["--mode", "train", "--faults"])
+    assert proc.returncode != 0
+    assert "--faults only applies" in proc.stderr
 
 
 @pytest.mark.skipif(not os.environ.get("DEEPGO_BENCH_FULL"),

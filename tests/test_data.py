@@ -83,6 +83,66 @@ def test_loader_off_depth_get_with_stacked_workers(fixture_dataset):
         assert np.asarray(part["packed"]).shape == (2, 4, 9, 19, 19)
 
 
+def test_loader_close_unblocks_uploader_parked_in_put(fixture_dataset, capfd):
+    # the consumer stops pulling with the device queue full, so the
+    # uploader is parked inside _dev_queue.put(): close() must drain the
+    # queue to let it exit, and return with NO leak warning
+    import time
+
+    from deepgo_tpu.data.loader import AsyncLoader
+
+    ds = GoDataset(fixture_dataset, "test")
+    loader = AsyncLoader(ds, 2, scheme="uniform", seed=3, num_threads=1,
+                         prefetch=2, device_prefetch=1)
+    loader.get()  # uploader is live; let it refill the device queue
+    deadline = time.monotonic() + 5
+    while loader._dev_queue.qsize() < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    loader.close()
+    assert not any(t.is_alive() for t in loader._threads)
+    assert "still alive" not in capfd.readouterr().err
+
+
+def test_loader_close_logs_leaked_thread_loudly(fixture_dataset, capfd,
+                                                monkeypatch):
+    # an uploader blocked inside jax.device_put (a wedged device/relay)
+    # cannot be joined: close() must still return promptly and report the
+    # leak on stderr instead of pretending the shutdown was clean
+    import threading
+    import time
+
+    import deepgo_tpu.data.loader as loader_mod
+    from deepgo_tpu.data.loader import AsyncLoader
+
+    release = threading.Event()
+    entered = threading.Event()
+    armed = threading.Event()
+    real_put = loader_mod.jax.device_put
+
+    def wedged_put(batch, *a, **kw):
+        if armed.is_set():
+            entered.set()
+            release.wait(30)  # stand-in for the C call that never returns
+        return real_put(batch, *a, **kw)
+
+    monkeypatch.setattr(loader_mod.jax, "device_put", wedged_put)
+    ds = GoDataset(fixture_dataset, "test")
+    loader = AsyncLoader(ds, 2, scheme="uniform", seed=3, num_threads=1,
+                         prefetch=1, device_prefetch=1)
+    try:
+        loader.get()  # pipeline is live
+        armed.set()
+        assert entered.wait(10)  # uploader is now wedged in device_put
+        t0 = time.monotonic()
+        loader.close(timeout=0.5)
+        assert time.monotonic() - t0 < 5, "close() hung on the wedge"
+        assert loader._uploader.is_alive()
+        err = capfd.readouterr().err
+        assert "still alive" in err and "loader-uploader" in err
+    finally:
+        release.set()
+
+
 def test_game_sampling_in_range(fixture_dataset):
     ds = GoDataset(fixture_dataset, "validation")
     rng = np.random.default_rng(7)

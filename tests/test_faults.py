@@ -136,6 +136,53 @@ def test_retry_delay_capped():
     assert delays == [1.0, 2.0, 2.0, 2.0]
 
 
+def test_retry_full_jitter_bounded_and_decorrelated():
+    # jitter=True draws each sleep U(0, envelope): inside the exponential
+    # envelope, reproducible under a seeded rng, and the envelope itself
+    # keeps growing (the cap still applies)
+    import random
+
+    delays = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 4:
+            raise OSError("x")
+        return 1
+
+    retry_with_backoff(flaky, attempts=5, base_delay=1.0, max_delay=2.0,
+                       on_retry=lambda e, a, d: delays.append(d),
+                       sleep=lambda d: None, jitter=True,
+                       rng=random.Random(0))
+    ref = random.Random(0)
+    assert delays == [ref.uniform(0, 1.0), ref.uniform(0, 2.0),
+                      ref.uniform(0, 2.0), ref.uniform(0, 2.0)]
+    for d, envelope in zip(delays, [1.0, 2.0, 2.0, 2.0]):
+        assert 0.0 <= d <= envelope
+    # two herd members with different rngs sleep different amounts — the
+    # decorrelation that motivates the mode
+    other = random.Random(1)
+    assert delays != [other.uniform(0, e) for e in [1.0, 2.0, 2.0, 2.0]]
+
+
+def test_retry_on_retry_sees_actual_jittered_delay():
+    # on_retry and sleep must observe the SAME drawn value
+    import random
+
+    seen, slept = [], []
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        retry_with_backoff(always, attempts=3, base_delay=0.5,
+                           on_retry=lambda e, a, d: seen.append(d),
+                           sleep=slept.append, jitter=True,
+                           rng=random.Random(2))
+    assert seen == slept and len(seen) == 2
+
+
 # ---- fault points in real paths ----
 
 

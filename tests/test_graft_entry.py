@@ -71,6 +71,24 @@ def test_dryrun_never_touches_default_backend():
     assert "one train step done" in r.stdout, (r.stdout, r.stderr[-2000:])
 
 
+def test_watchdog_disarm_survives_past_fuse():
+    # the complement of the kill test: an armed-then-DISARMED process must
+    # outlive its fuse — a disarm that merely forgets the handle would
+    # leave the child to kill a healthy run at timeout
+    r = _run(
+        "import __graft_entry__ as g, time\n"
+        "wd = g._arm_watchdog('test', timeout_s=2)\n"
+        "wd.disarm()\n"
+        "time.sleep(4)\n"  # well past the 2s fuse
+        "print('SURVIVED PAST FUSE')\n",
+        {"GRAFT_WATCHDOG": "1"},  # pin against ambient =0
+        timeout=30,
+    )
+    assert "SURVIVED PAST FUSE" in r.stdout, (r.stdout, r.stderr[-2000:])
+    assert r.returncode == 0
+    assert "watchdog" not in r.stderr
+
+
 def test_watchdog_kills_wedged_process():
     # Simulate the wedge: arm the watchdog with a short fuse, then block in
     # a C-level sleep. The external watchdog must SIGKILL the process.

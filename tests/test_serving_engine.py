@@ -166,23 +166,76 @@ class TestZeroRecompile:
 
 class TestLifecycle:
     def test_dispatcher_death_surfaces_on_next_submit(self):
-        # mirror of the AsyncLoader worker-death contract: the poisoned
+        # mirror of the AsyncLoader worker-death contract: the in-flight
         # request's future carries the error, and every later submit()
-        # raises instead of deadlocking its waiter
-        def bomb(params, packed, player, rank):
-            raise ValueError("model exploded")
+        # raises instead of deadlocking its waiter. (A FORWARD exception
+        # no longer kills the dispatcher — that's batch containment,
+        # tests/test_supervisor.py — so death is injected at the
+        # dispatch-loop fault point, outside the containment.)
+        from deepgo_tpu.utils import faults
 
-        engine = InferenceEngine(bomb, None,
+        faults.install("serving_dispatch:fail@1")
+        try:
+            engine = InferenceEngine(
+                lambda p, pk, pl, rk: np.zeros(len(pk), np.float32), None,
+                EngineConfig(buckets=(4,), max_wait_ms=0.0))
+            f = engine.submit(*_one_board())
+            with pytest.raises(faults.InjectedFailure):
+                f.result(timeout=5)
+            deadline = time.monotonic() + 5
+            while engine._thread.is_alive() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            with pytest.raises(EngineError, match="dispatcher thread died"):
+                engine.submit(*_one_board())
+            engine.close()  # must not hang on a dead dispatcher
+        finally:
+            faults.reset()
+
+    def test_forward_error_contained_to_its_batch(self):
+        # one exploding dispatch fails typed (cause attached) and the
+        # dispatcher keeps serving later submitters
+        from deepgo_tpu.serving import BatchDispatchError
+
+        calls = {"n": 0}
+
+        def flaky(params, packed, player, rank):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("model exploded")
+            return np.zeros(len(packed), np.float32)
+
+        engine = InferenceEngine(flaky, None,
                                  EngineConfig(buckets=(4,), max_wait_ms=0.0))
+        try:
+            f = engine.submit(*_one_board())
+            with pytest.raises(BatchDispatchError) as ei:
+                f.result(timeout=5)
+            assert isinstance(ei.value.__cause__, ValueError)
+            assert engine.submit(*_one_board()).result(timeout=5).shape == ()
+            assert engine.stats()["dispatch_failures"] == 1
+        finally:
+            engine.close()
+
+    def test_wedged_close_is_loud_not_silent(self, capfd):
+        # a dispatcher that won't exit by the close deadline must be
+        # visible: stderr warning + stats flag, not a clean-looking return
+        release = threading.Event()
+        entered = threading.Event()
+
+        def slow(params, packed, player, rank):
+            entered.set()
+            assert release.wait(10)
+            return np.zeros(len(packed), np.float32)
+
+        engine = InferenceEngine(slow, None,
+                                 EngineConfig(buckets=(1,), max_wait_ms=0.0))
         f = engine.submit(*_one_board())
-        with pytest.raises(ValueError, match="model exploded"):
-            f.result(timeout=5)
-        deadline = time.monotonic() + 5
-        while engine._thread.is_alive() and time.monotonic() < deadline:
-            time.sleep(0.01)
-        with pytest.raises(EngineError, match="dispatcher thread died"):
-            engine.submit(*_one_board())
-        engine.close()  # must not hang on a dead dispatcher
+        assert entered.wait(5)  # dispatcher now stuck inside the forward
+        engine.close(timeout=0.2)
+        assert engine.stats()["dispatcher_wedged"] is True
+        assert "did not exit" in capfd.readouterr().err
+        release.set()  # let the wedged thread finish; its future resolves
+        assert f.result(timeout=5).shape == ()
 
     def test_close_drains_pending_futures(self):
         cfg, params = tiny()

@@ -1,0 +1,641 @@
+"""Resilience layer over the serving path (serving/supervisor.py).
+
+The load-bearing contracts, each asserted deterministically (injectable
+clock / sleep / rng — no wall-time races):
+
+  * dispatcher death is absorbed: the engine is rebuilt with bounded
+    exponential full-jitter backoff and in-flight requests REPLAY with
+    bit-identical results (the forward is pure);
+  * batch poison is isolated: one bad row fails alone with a typed
+    PoisonedRequest + atomic quarantine dump, while its coalesced
+    neighbors succeed; transient faults never condemn an innocent;
+  * the circuit breaker walks closed -> open -> half-open -> closed with
+    single-probe recovery, shedding typed CircuitOpen while open;
+  * admission control sheds typed EngineOverloaded when the estimated
+    queue wait already exceeds the deadline;
+  * under DEEPGO_FAULTS chaos (dispatcher kill + transient forwards) a
+    mixed selfplay/evaluate workload completes with every future
+    resolved and results bit-identical to a fault-free run.
+"""
+
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepgo_tpu.models import ModelConfig, init
+from deepgo_tpu.models.serving import make_log_prob_fn
+from deepgo_tpu.serving import (BatchDispatchError, CircuitBreaker,
+                                CircuitOpen, EngineClosed, EngineConfig,
+                                EngineOverloaded, InferenceEngine,
+                                PoisonedRequest, RestartsExhausted,
+                                SupervisedEngine, SupervisorConfig,
+                                full_jitter_delay)
+from deepgo_tpu.utils import faults
+from deepgo_tpu.utils.metrics import MetricsWriter, read_jsonl
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Each test starts (and leaves) with no active plan and no env."""
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def tiny():
+    cfg = ModelConfig(num_layers=2, channels=8)
+    return cfg, init(jax.random.key(0), cfg)
+
+
+def boards(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 3, size=(n, 9, 19, 19), dtype=np.uint8),
+            rng.integers(1, 3, size=n).astype(np.int32),
+            rng.integers(1, 10, size=n).astype(np.int32))
+
+
+def one_board(seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 3, size=(9, 19, 19), dtype=np.uint8), 1, 5)
+
+
+POISON_BOARD = np.full((9, 19, 19), 255, dtype=np.uint8)
+
+
+def marker_forward(params, packed, player, rank):
+    """Row-independent toy forward that detonates iff the poison marker
+    (an all-255 board) rides the batch — the deterministic stand-in for a
+    request whose content crashes the real model."""
+    if (packed == 255).all(axis=(1, 2, 3)).any():
+        raise ValueError("poison row in batch")
+    return np.asarray(packed, np.float32).sum(axis=(1, 2, 3)) \
+        + 1000.0 * np.asarray(player, np.float32)
+
+
+def ok_forward(params, packed, player, rank):
+    return np.asarray(packed, np.float32).sum(axis=(1, 2, 3))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_sup(forward, engine_config=None, sup_config=None, **kw):
+    ecfg = engine_config or EngineConfig(buckets=(1, 4), max_wait_ms=0.0)
+    kw.setdefault("rng", random.Random(0))
+    return SupervisedEngine(
+        lambda: InferenceEngine(forward, None, ecfg, name="inner"),
+        config=sup_config, name="test", **kw)
+
+
+# ---- circuit breaker unit ----
+
+
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_failures_only(self):
+        clk = FakeClock()
+        br = CircuitBreaker(failures=3, reset_timeout_s=10, clock=clk)
+        for _ in range(2):
+            br.record_failure()
+        br.record_success()  # resets the consecutive count
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == "closed" and br.allow()
+        br.record_failure()  # third consecutive
+        assert br.state == "open" and not br.allow()
+
+    def test_single_probe_recovery(self):
+        clk = FakeClock()
+        transitions = []
+        br = CircuitBreaker(failures=1, reset_timeout_s=10, clock=clk,
+                            on_transition=lambda a, b: transitions.append(
+                                (a, b)))
+        br.record_failure()
+        assert not br.allow()
+        clk.advance(9.9)
+        assert not br.allow()  # recovery timer not yet due
+        clk.advance(0.2)
+        assert br.allow()          # THE probe
+        assert br.state == "half_open"
+        assert not br.allow()      # everyone else sheds while it's out
+        br.record_success()
+        assert br.state == "closed" and br.allow()
+        assert transitions == [("closed", "open"), ("open", "half_open"),
+                               ("half_open", "closed")]
+
+    def test_failed_probe_reopens_and_rearms_timer(self):
+        clk = FakeClock()
+        br = CircuitBreaker(failures=1, reset_timeout_s=10, clock=clk)
+        br.record_failure()
+        clk.advance(11)
+        assert br.allow()
+        br.record_failure()  # probe failed
+        assert br.state == "open"
+        assert not br.allow()  # timer restarted: no instant second probe
+        clk.advance(11)
+        assert br.allow()
+
+    def test_cancelled_probe_returns_to_next_caller(self):
+        clk = FakeClock()
+        br = CircuitBreaker(failures=1, reset_timeout_s=10, clock=clk)
+        br.record_failure()
+        clk.advance(11)
+        assert br.allow()
+        br.cancel_probe()  # granted but never sent (e.g. EngineBusy)
+        assert br.state == "open"
+        assert br.allow()  # immediately re-granted, not timed out again
+        assert br.state == "half_open"
+
+    def test_any_success_closes_from_open(self):
+        # internal replays after a restart are real traffic; their success
+        # must not wait out reset_timeout_s
+        clk = FakeClock()
+        br = CircuitBreaker(failures=1, reset_timeout_s=1e9, clock=clk)
+        br.record_failure()
+        assert br.state == "open"
+        br.record_success()
+        assert br.state == "closed" and br.allow()
+
+
+class TestFullJitter:
+    def test_bounds_and_determinism(self):
+        rng = random.Random(7)
+        ref = random.Random(7)
+        for attempt in range(6):
+            d = full_jitter_delay(attempt, 0.05, 2.0, rng)
+            envelope = min(2.0, 0.05 * 2 ** attempt)
+            assert 0.0 <= d <= envelope
+            assert d == ref.uniform(0.0, envelope)  # seeded-reproducible
+
+
+# ---- engine-level containment (the primitives the supervisor rides) ----
+
+
+class TestEngineContainment:
+    def test_forward_error_fails_batch_not_dispatcher(self):
+        engine = InferenceEngine(marker_forward, None,
+                                 EngineConfig(buckets=(1,), max_wait_ms=0.0))
+        try:
+            bad = engine.submit(POISON_BOARD, 1, 5)
+            with pytest.raises(BatchDispatchError) as ei:
+                bad.result(timeout=5)
+            assert ei.value.batch_size == 1
+            assert isinstance(ei.value.__cause__, ValueError)
+            # the dispatcher survived: later submits still serve
+            ok = engine.submit(*one_board())
+            assert ok.result(timeout=5).shape == ()
+            assert engine.stats()["dispatch_failures"] == 1
+        finally:
+            engine.close()
+
+    def test_solo_lane_dispatches_strictly_alone(self):
+        sizes = []
+
+        def recording(params, packed, player, rank):
+            sizes.append(len(packed))
+            return np.zeros(len(packed), np.float32)
+
+        # a huge coalescing window would normally glue these together
+        engine = InferenceEngine(recording, None,
+                                 EngineConfig(buckets=(1, 8),
+                                              max_wait_ms=200.0))
+        try:
+            futs = [engine.submit(*one_board(i), solo=True)
+                    for i in range(3)]
+            for f in futs:
+                f.result(timeout=5)
+            assert sizes == [1, 1, 1]
+        finally:
+            engine.close()
+
+    def test_serving_dispatch_fault_kills_dispatcher(self):
+        faults.install("serving_dispatch:fail@1")
+        engine = InferenceEngine(ok_forward, None,
+                                 EngineConfig(buckets=(1,), max_wait_ms=0.0))
+        f = engine.submit(*one_board())
+        with pytest.raises(faults.InjectedFailure):
+            f.result(timeout=5)
+        engine.close()
+
+
+# ---- restart + replay ----
+
+
+class TestRestart:
+    def test_dispatcher_death_restarts_and_replays_bitwise(self):
+        cfg, params = tiny()
+        forward = make_log_prob_fn(cfg)
+        packed, players, ranks = boards(4)
+        direct = np.asarray(forward(params, packed, players, ranks))
+
+        faults.install("serving_dispatch:fail@1")
+        delays = []
+        sup = SupervisedEngine(
+            lambda: InferenceEngine(forward, params,
+                                    EngineConfig(buckets=(1, 4),
+                                                 max_wait_ms=0.0)),
+            name="t", sleep=delays.append, rng=random.Random(0))
+        try:
+            got = sup.evaluate(packed, players, ranks)
+            assert np.array_equal(got, direct)
+            h = sup.health()
+            assert h["restarts"] == 1
+            assert h["consecutive_restarts"] == 0  # reset by the successes
+            assert h["replayed"] >= 1
+            assert h["state"] == "serving"
+            # full-jitter backoff: seeded rng, first-attempt envelope
+            assert delays == [random.Random(0).uniform(0.0, 0.05)]
+        finally:
+            sup.close()
+
+    def test_submits_during_outage_ride_through(self):
+        # kill the dispatcher, then submit AGAINST THE CORPSE before the
+        # supervisor has rebuilt: the request must park, replay, resolve
+        faults.install("serving_dispatch:fail@1")
+        release = threading.Event()
+        sup = make_sup(ok_forward, sleep=lambda d: release.wait(5))
+        f1 = sup.submit(*one_board())  # dies with the first window
+        deadline = time.monotonic() + 5
+        while sup._engine._error is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        f2 = sup.submit(*one_board(1))  # lands on the corpse
+        release.set()
+        assert f1.result(timeout=5) is not None
+        assert f2.result(timeout=5) is not None
+        assert sup.health()["restarts"] == 1
+        sup.close()
+
+    def test_restart_backoff_envelope_grows(self):
+        # three consecutive deaths, no success in between: delays must
+        # stay inside the doubling envelope and match the seeded rng
+        faults.install("serving_dispatch:transient@3")
+        delays = []
+        sup = make_sup(ok_forward, sleep=delays.append,
+                       rng=random.Random(3))
+        f = sup.submit(*one_board())
+        assert f.result(timeout=10) is not None
+        ref = random.Random(3)
+        assert delays == [ref.uniform(0, 0.05), ref.uniform(0, 0.1),
+                          ref.uniform(0, 0.2)]
+        assert sup.health()["restarts"] == 3
+        sup.close()
+
+    def test_restart_budget_exhaustion_is_typed_not_stranded(self):
+        faults.install("serving_dispatch:transient@100")
+        sup = make_sup(ok_forward, sleep=lambda d: None,
+                       sup_config=SupervisorConfig(max_restarts=2))
+        f = sup.submit(*one_board())
+        with pytest.raises(RestartsExhausted):
+            f.result(timeout=10)
+        with pytest.raises(RestartsExhausted):
+            sup.submit(*one_board())
+        assert sup.health()["state"] == "failed"
+        sup.close()
+
+    def test_restart_reuses_warm_jit_cache(self):
+        # the factory closes over ONE jitted forward, so the rebuilt
+        # engine replays on already-compiled shapes: zero new compiles
+        cfg, params = tiny()
+        forward = make_log_prob_fn(cfg)
+        sup = SupervisedEngine(
+            lambda: InferenceEngine(forward, params,
+                                    EngineConfig(buckets=(1, 4),
+                                                 max_wait_ms=0.0)),
+            name="t", sleep=lambda d: None, rng=random.Random(0))
+        try:
+            sup.warmup()
+            warm = sup.compile_cache_size()
+            faults.install("serving_dispatch:fail@1")
+            got = sup.evaluate(*boards(4))
+            assert got.shape == (4, 361)
+            assert sup.health()["restarts"] == 1
+            assert sup.compile_cache_size() == warm, \
+                "restart triggered XLA recompilation"
+        finally:
+            sup.close()
+
+
+# ---- batch-poison isolation ----
+
+
+class TestPoisonIsolation:
+    def test_one_bad_row_fails_alone_neighbors_succeed(self, tmp_path):
+        writer = MetricsWriter(str(tmp_path / "m.jsonl"))
+        qdir = str(tmp_path / "quarantine")
+        sup = make_sup(
+            marker_forward,
+            engine_config=EngineConfig(buckets=(1, 8), max_wait_ms=100.0),
+            sup_config=SupervisorConfig(quarantine_dir=qdir),
+            metrics=writer)
+        try:
+            packed, players, ranks = boards(3, seed=9)
+            innocents = [sup.submit(packed[i], int(players[i]),
+                                    int(ranks[i])) for i in range(3)]
+            bad = sup.submit(POISON_BOARD, 2, 7)
+            # neighbors bit-identical to a solo fault-free forward
+            want = marker_forward(None, packed, players, ranks)
+            for i, f in enumerate(innocents):
+                assert f.result(timeout=10) == want[i]
+            with pytest.raises(PoisonedRequest) as ei:
+                bad.result(timeout=10)
+            assert isinstance(ei.value.__cause__, BatchDispatchError)
+
+            h = sup.health()
+            assert h["poisoned"] == 1
+            assert h["restarts"] == 0, "poison must not restart the engine"
+            # atomic quarantine dump carries the offending inputs
+            [qpath] = h["quarantined"]
+            dump = np.load(qpath)
+            assert np.array_equal(dump["packed"], POISON_BOARD)
+            assert int(dump["player"]) == 2 and int(dump["rank"]) == 7
+            assert "poison row" in str(dump["error"])
+            assert sorted(os.listdir(qdir)) == ["poison-0001.npz"]
+        finally:
+            sup.close()
+            writer.close()
+        kinds = [r["kind"] for r in read_jsonl(str(tmp_path / "m.jsonl"))]
+        assert "serving_poison" in kinds
+
+    def test_transient_batch_fault_poisons_nobody(self):
+        # the first two forward dispatches fail transiently: the batch is
+        # bisected, the solo retries exhaust the transient budget, and
+        # every request succeeds — poison_threshold >= 2 keeps one-shot
+        # weather from condemning an innocent
+        faults.install("serving_forward:transient@2")
+        sup = make_sup(ok_forward,
+                       engine_config=EngineConfig(buckets=(1, 4),
+                                                  max_wait_ms=50.0))
+        try:
+            futs = [sup.submit(*one_board(i)) for i in range(4)]
+            for f in futs:
+                assert f.result(timeout=10) is not None
+            h = sup.health()
+            assert h["poisoned"] == 0
+            assert h["restarts"] == 0
+        finally:
+            sup.close()
+
+    def test_quarantine_optional(self):
+        # no quarantine_dir: the poison verdict still lands, typed
+        sup = make_sup(marker_forward)
+        try:
+            with pytest.raises(PoisonedRequest):
+                sup.submit(POISON_BOARD, 1, 5).result(timeout=10)
+            assert sup.health()["quarantined"] == []
+        finally:
+            sup.close()
+
+
+# ---- deadline-aware admission control ----
+
+
+def _blocked_engine_sup(release, entered, **kw):
+    def slow(params, packed, player, rank):
+        entered.set()
+        assert release.wait(10)
+        return np.zeros(len(packed), np.float32)
+
+    return make_sup(slow,
+                    engine_config=EngineConfig(buckets=(1,), max_wait_ms=0.0),
+                    **kw)
+
+
+class TestAdmissionControl:
+    def test_sheds_when_estimated_wait_exceeds_deadline(self):
+        release, entered = threading.Event(), threading.Event()
+        sup = _blocked_engine_sup(release, entered)
+        try:
+            inflight = sup.submit(*one_board())
+            assert entered.wait(5)
+            queued = [sup.submit(*one_board(i)) for i in range(1, 4)]
+            # seed the rolling dispatch-latency window: p50 = 0.2s, three
+            # queued one-request windows -> estimated wait 0.6s
+            sup._engine._dispatch_secs.extend([0.2] * 5)
+            assert sup.estimated_wait_s() == pytest.approx(0.6)
+            with pytest.raises(EngineOverloaded):
+                sup.submit(*one_board(9), timeout_s=0.5)
+            # a deadline the queue CAN meet is admitted
+            ok = sup.submit(*one_board(10), timeout_s=30.0)
+            # no deadline: never shed by admission
+            nodl = sup.submit(*one_board(11))
+            assert sup.health()["shed_overload"] == 1
+            release.set()
+            for f in (inflight, *queued, ok, nodl):
+                assert f.result(timeout=10) is not None
+        finally:
+            release.set()
+            sup.close()
+
+    def test_no_estimate_before_first_dispatch(self):
+        sup = make_sup(ok_forward)
+        try:
+            assert sup.estimated_wait_s() is None
+            # and admission therefore never rejects
+            assert sup.submit(*one_board(),
+                              timeout_s=1e-9) is not None
+        finally:
+            sup.close()
+
+
+# ---- breaker integration ----
+
+
+class TestBreakerIntegration:
+    def test_open_sheds_then_probe_recovers(self, tmp_path):
+        # forward faults with no interleaved successes open the breaker;
+        # a fake clock drives the recovery window; the half-open probe's
+        # success closes it — all transitions land in the metrics stream
+        writer = MetricsWriter(str(tmp_path / "m.jsonl"))
+        clk = FakeClock()
+        faults.install("serving_forward:transient@6")
+        sup = make_sup(
+            ok_forward,
+            engine_config=EngineConfig(buckets=(1,), max_wait_ms=0.0),
+            sup_config=SupervisorConfig(breaker_failures=2,
+                                        breaker_reset_s=30.0,
+                                        poison_threshold=1000),
+            metrics=writer, clock=clk)
+        try:
+            f = sup.submit(*one_board())
+            # the lone request keeps failing solo (transient budget 6 >
+            # any retry it gets) until the breaker opens; wait for it
+            deadline = time.monotonic() + 5
+            while (sup._breaker.state != "open"
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert sup._breaker.state == "open"
+            with pytest.raises(CircuitOpen):
+                sup.submit(*one_board(1))
+            assert sup.health()["shed_breaker"] == 1
+
+            clk.advance(31)  # recovery due: next submit is THE probe
+            probe = sup.submit(*one_board(2))
+            assert probe.result(timeout=10) is not None
+            assert sup._breaker.state == "closed"
+            sup.submit(*one_board(3)).result(timeout=10)
+        finally:
+            sup.close()
+            writer.close()
+        records = read_jsonl(str(tmp_path / "m.jsonl"))
+        moves = [(r["from_state"], r["to_state"]) for r in records
+                 if r["kind"] == "serving_breaker"]
+        assert ("closed", "open") in moves
+        assert ("open", "half_open") in moves
+        # the retried first request may close it from open before the
+        # probe; either closing edge is a correct recovery
+        assert ("half_open", "closed") in moves or ("open", "closed") in moves
+        del f
+
+
+# ---- chaos: the acceptance scenario ----
+
+
+class TestChaos:
+    def test_mixed_selfplay_evaluate_chaos_bitwise(self, tmp_path):
+        """Dispatcher kill + transient forward faults under a mixed
+        selfplay + evaluate workload: every future resolves, results are
+        bit-identical to the fault-free run, restarts are counted, and
+        the metrics stream records them."""
+        from deepgo_tpu.selfplay import self_play
+
+        cfg, params = tiny()
+        forward = make_log_prob_fn(cfg)
+
+        # fault-free references
+        ref_games, _ = self_play(params, cfg, n_games=4, max_moves=20,
+                                 seed=5)
+        packed_fix, players_fix, ranks_fix = boards(6, seed=11)
+        ref_rows = np.asarray(
+            forward(params, packed_fix, players_fix, ranks_fix))
+
+        faults.install(
+            "serving_dispatch:fail@2,serving_forward:transient@2")
+        writer = MetricsWriter(str(tmp_path / "chaos.jsonl"))
+        sup = SupervisedEngine(
+            lambda: InferenceEngine(forward, params,
+                                    EngineConfig(buckets=(1, 2, 4, 8),
+                                                 max_wait_ms=2.0)),
+            config=SupervisorConfig(breaker_failures=50),
+            name="chaos", metrics=writer, rng=random.Random(1))
+        errors = []
+
+        def arena_like():
+            try:
+                for _ in range(3):
+                    got = sup.evaluate(packed_fix, players_fix, ranks_fix)
+                    assert np.array_equal(got, ref_rows)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        side = threading.Thread(target=arena_like)
+        try:
+            sup.warmup()
+            side.start()
+            games, stats = self_play(params, cfg, n_games=4, max_moves=20,
+                                     seed=5, engine=sup)
+            side.join(timeout=60)
+            assert not side.is_alive() and not errors, errors
+
+            # bit-identical trajectories: replayed/bisected requests
+            # returned exactly the rows the fault-free run saw
+            assert [[(m.player, m.x, m.y) for m in g.moves]
+                    for g in games] == \
+                   [[(m.player, m.x, m.y) for m in g.moves]
+                    for g in ref_games]
+
+            h = sup.health()
+            assert h["restarts"] >= 1
+            assert h["poisoned"] == 0
+            assert h["state"] == "serving"
+            assert stats["engine"]["supervisor"]["restarts"] >= 1
+        finally:
+            sup.close()
+            writer.close()
+        kinds = {r["kind"] for r in read_jsonl(str(tmp_path / "chaos.jsonl"))}
+        assert "serving_restart" in kinds
+        assert "serving_supervisor_close" in kinds
+
+    def test_close_resolves_everything(self):
+        # close() on a supervisor with parked work: futures resolve with
+        # typed EngineClosed, never strand
+        release, entered = threading.Event(), threading.Event()
+        sup = _blocked_engine_sup(release, entered)
+        inflight = sup.submit(*one_board())
+        assert entered.wait(5)
+        queued = [sup.submit(*one_board(i)) for i in range(1, 4)]
+        closer = threading.Thread(target=lambda: sup.close(drain=False))
+        closer.start()
+        deadline = time.monotonic() + 5
+        while not sup._closing.is_set() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        release.set()
+        closer.join(timeout=10)
+        assert not closer.is_alive(), "close() hung"
+        assert inflight.result(timeout=5) is not None
+        for f in queued:
+            # the contract is RESOLVED, never stranded: depending on how
+            # far the dispatcher got before the cancel landed, a queued
+            # request either drained (result) or failed typed
+            try:
+                assert f.result(timeout=5) is not None
+            except EngineClosed:
+                pass
+        with pytest.raises(EngineClosed):
+            sup.submit(*one_board())
+
+
+# ---- shared-registry + agent routing ----
+
+
+class TestSupervisedRouting:
+    def test_shared_registry_supervised_is_distinct_and_duck_typed(self):
+        from deepgo_tpu.serving import (close_shared_engines,
+                                        shared_policy_engine)
+
+        cfg, params = tiny()
+        try:
+            plain = shared_policy_engine(params, cfg)
+            sup = shared_policy_engine(params, cfg, supervised=True)
+            assert plain is not sup
+            assert isinstance(sup, SupervisedEngine)
+            assert sup is shared_policy_engine(params, cfg, supervised=True)
+            packed, players, ranks = boards(2, seed=3)
+            assert np.array_equal(sup.evaluate(packed, players, ranks),
+                                  plain.evaluate(packed, players, ranks))
+        finally:
+            close_shared_engines()
+
+    def test_policy_agent_on_supervised_engine_matches_direct(self):
+        from deepgo_tpu.agents import PolicyAgent
+        from deepgo_tpu.selfplay import legal_mask
+
+        cfg, params = tiny()
+        packed, players, _ = boards(5, seed=9)
+        legal = legal_mask(packed, players)
+        forward = make_log_prob_fn(cfg)
+        faults.install("serving_dispatch:fail@1")  # restart mid-agent-call
+        with SupervisedEngine(
+                lambda: InferenceEngine(forward, params,
+                                        EngineConfig(buckets=(1, 8),
+                                                     max_wait_ms=0.0)),
+                name="agent", rng=random.Random(0)) as sup:
+            on_engine = PolicyAgent(params, cfg, engine=sup)
+            direct = PolicyAgent(params, cfg)
+            got = on_engine._legal_log_probs(packed, players, legal)
+            want = direct._legal_log_probs(packed, players, legal)
+            assert np.array_equal(got, want)
+            assert sup.health()["restarts"] == 1
