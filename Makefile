@@ -1,7 +1,7 @@
 # Developer entry points. `scripts/setup.sh` chains native + data + test.
 
 .PHONY: native data test test-full verify verify-faults verify-serving \
-    verify-resilience bench smoke clean
+    verify-resilience verify-distributed bench smoke clean
 
 native:
 	$(MAKE) -C native
@@ -27,7 +27,12 @@ verify-serving:  # batching engine: bucket bitwise parity, zero-recompile, lifec
 verify-resilience:  # fault-injected serving: restart+replay, poison isolation, breaker, shedding
 	JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q
 
-verify: verify-faults verify-serving verify-resilience  # the full failure-model suite
+verify-distributed:  # multi-host elastic: liveness, deadlines, subprocess chaos recovery
+	JAX_PLATFORMS=cpu python -m pytest tests/test_liveness.py \
+	    tests/test_deadlines.py tests/test_elastic.py \
+	    tests/test_distributed.py tests/test_watchdog.py -q
+
+verify: verify-faults verify-serving verify-resilience verify-distributed  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -41,6 +41,13 @@ Extra modes (round-2 verdict items 2 and 5), each also one JSON line:
                    that actually resolved per second — plus the restart /
                    shed / poison counters, so the cost of surviving
                    failure is measured rather than asserted.
+  --mode distributed [--faults [SPEC]]
+                   2-host elastic training (CPU subprocesses over a shared
+                   run dir; parallel/elastic.py). With --faults the victim
+                   host is SIGKILLed mid-training and the line reports the
+                   survivor's RECOVERY LATENCY plus steps lost to the
+                   checkpoint rollback; without, the clean 2-host run
+                   reports the elastic layer's overhead as samples/sec.
 """
 
 from __future__ import annotations
@@ -70,6 +77,7 @@ _METRIC_OF = {
     "latency": ("policy_inference_latency_ms", "ms p50 (includes relay RTT)"),
     "large": ("large_training_samples_per_sec_per_chip", "samples/sec"),
     "serving": ("serving_engine_boards_per_sec_per_chip", "boards/sec"),
+    "distributed": ("distributed_elastic_recovery_latency_s", "s"),
 }
 
 
@@ -476,6 +484,140 @@ def _bench_latency(on_tpu: bool) -> dict:
 # restart and poison-isolation paths absorb
 DEFAULT_CHAOS_FAULTS = "serving_dispatch:fail@3,serving_forward:transient@2"
 
+# default --mode distributed chaos: SIGKILL the victim host once its step
+# counter reaches 7 (the honest preemption; same site the PR 1
+# kill-and-resume test uses)
+DEFAULT_DIST_FAULTS = "kill:step@7"
+
+
+def _bench_distributed(faults_spec: str | None = None) -> dict:
+    """2-host elastic training chaos run (CPU subprocesses, simulated hosts).
+
+    Spawns two ``cli train --elastic`` hosts over a shared run directory
+    (the subprocess harness the slow test in tests/test_elastic.py drives;
+    docs/robustness.md "Distributed failure domains"). With ``faults_spec``
+    the victim host gets it as DEEPGO_FAULTS — the default SIGKILLs the
+    victim mid-training — and the headline value is the survivor's measured
+    RECOVERY LATENCY (last beat of the dead host -> training resumed from
+    the converged checkpoint), with steps-lost and heartbeat counters
+    alongside. Without faults it is the clean 2-host elastic run: value is
+    the survivor's samples/sec, i.e. the elastic layer's overhead measured
+    rather than guessed.
+
+    Deliberately CPU: this container's backend has no cross-process
+    collectives, and the machinery under test — liveness, convergence,
+    re-mesh, bit-exact resume — is host-side orchestration that behaves
+    identically wherever the step math runs."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="deepgo-dist-bench-")
+    try:
+        from deepgo_tpu.data.transcribe import transcribe_split
+
+        data_root = os.path.join(tmp, "processed")
+        for split in ("validation", "test"):
+            transcribe_split(os.path.join(repo, "data/sgf", split),
+                             os.path.join(data_root, split),
+                             workers=1, verbose=False)
+        run_dir = os.path.join(tmp, "run")
+        iters = 240
+        # checkpoints every 20 steps but liveness windows every 5: detection
+        # usually lands BETWEEN checkpoints, so the steps-lost counter
+        # measures the real rollback cost instead of a structural zero
+        sets = [
+            "name=dist-bench", "num_layers=2", "channels=8", "batch_size=8",
+            "rate=0.05", "validation_size=16", "validation_interval=20",
+            "print_interval=5", f"data_root={data_root}",
+            "train_split=validation", "validation_split=test",
+            "loader_threads=0", "data_parallel=2", "keep_checkpoints=0",
+        ]
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("DEEPGO_FAULTS", "XLA_FLAGS", "PYTHONPATH")}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        procs = []
+        for host in (0, 1):
+            henv = dict(env)
+            if faults_spec and host == 1:
+                henv["DEEPGO_FAULTS"] = faults_spec
+            cmd = [sys.executable, "-m", "deepgo_tpu.cli", "train",
+                   "--iters", str(iters), "--elastic",
+                   "--auto-resume", run_dir,
+                   "--process-id", str(host), "--expected-hosts", "2",
+                   # the silence budget (interval x budget = 3s) must
+                   # comfortably cover a validation + checkpoint window
+                   # (which includes the one-off eval-step compile), or a
+                   # busy host reads as dead — the clean run would then
+                   # report phantom recoveries
+                   "--heartbeat-interval", "0.5", "--miss-budget", "6",
+                   "--init-deadline", "120", "--step-deadline", "300",
+                   "--set", *sets]
+            procs.append(subprocess.Popen(
+                cmd, cwd=repo, env=henv, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=480)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+            outs.append((p.returncode, out, err))
+        survivor_rc, survivor_out, survivor_err = outs[0]
+        done = [json.loads(l.split(" ", 1)[1])
+                for l in survivor_out.splitlines()
+                if l.startswith("ELASTIC_DONE ")]
+        recs = [json.loads(l.split(" ", 1)[1])
+                for l in survivor_out.splitlines()
+                if l.startswith("ELASTIC_RECOVERY ")]
+        if survivor_rc != 0 or not done:
+            return {
+                "metric": _METRIC_OF["distributed"][0],
+                "value": 0.0,
+                "unit": _METRIC_OF["distributed"][1],
+                "vs_baseline": None,
+                "error": (f"survivor rc={survivor_rc}; "
+                          + survivor_err[-400:].strip()),
+            }
+        summary = done[-1]
+        if faults_spec:
+            value = (round(recs[-1]["recovery_latency_s"], 3)
+                     if recs else 0.0)
+            result = {
+                "metric": _METRIC_OF["distributed"][0],
+                "value": value,
+                "unit": "s",
+                "vs_baseline": None,
+                "faults": faults_spec,
+                "victim_rc": outs[1][0],
+                "recoveries": summary["recoveries"],
+                "steps_lost": summary["steps_lost_total"],
+                "detect_latency_s": (round(recs[-1]["detect_latency_s"], 3)
+                                     if recs else None),
+                "final_step": summary["final_step"],
+                "survivor_samples_per_sec": round(
+                    summary.get("samples_per_sec", 0.0), 1),
+            }
+            if not recs:
+                result["error"] = ("no recovery observed (victim outlived "
+                                   "the run or faults spec never fired)")
+            return result
+        return {
+            "metric": "distributed_elastic_samples_per_sec",
+            "value": round(summary.get("samples_per_sec", 0.0), 1),
+            "unit": "samples/sec",
+            "vs_baseline": None,
+            "hosts": 2,
+            "recoveries": summary["recoveries"],
+            "final_step": summary["final_step"],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
 
 def _bench_serving(on_tpu: bool, faults_spec: str | None = None) -> dict:
     """Micro-batching engine throughput under concurrent submitters.
@@ -602,17 +744,34 @@ def main() -> None:
     ap = argparse.ArgumentParser(description="deepgo_tpu benchmarks")
     ap.add_argument("--mode", default="inference",
                     choices=["inference", "train", "latency", "large",
-                             "serving"])
-    ap.add_argument("--faults", nargs="?", const=DEFAULT_CHAOS_FAULTS,
+                             "serving", "distributed"])
+    ap.add_argument("--faults", nargs="?", const="__default__",
                     default=None, metavar="SPEC",
-                    help="(--mode serving only) chaos run: install this "
-                         "DEEPGO_FAULTS spec (default: "
-                         f"'{DEFAULT_CHAOS_FAULTS}'), run the engine "
-                         "under the resilience supervisor, and report "
-                         "goodput + restart/shed/poison counters")
+                    help="(--mode serving / distributed) chaos run: install "
+                         "this DEEPGO_FAULTS spec (serving default: "
+                         f"'{DEFAULT_CHAOS_FAULTS}'; distributed default: "
+                         f"'{DEFAULT_DIST_FAULTS}', given to the victim "
+                         "host). Serving reports goodput + restart/shed/"
+                         "poison counters; distributed reports recovery "
+                         "latency + steps lost")
     args = ap.parse_args()
-    if args.faults is not None and args.mode != "serving":
-        ap.error("--faults only applies to --mode serving")
+    if args.faults is not None and args.mode not in ("serving", "distributed"):
+        ap.error("--faults only applies to --mode serving or distributed")
+    if args.faults == "__default__":
+        args.faults = (DEFAULT_DIST_FAULTS if args.mode == "distributed"
+                       else DEFAULT_CHAOS_FAULTS)
+
+    if args.mode == "distributed":
+        # pure subprocess orchestration: the children pin JAX_PLATFORMS=cpu
+        # themselves (simulated hosts — see _bench_distributed), so the
+        # parent never claims a device and the preflight probe would only
+        # add latency. The external watchdog still bounds the whole run.
+        watchdog = _arm_watchdog(args.mode)
+        result = _bench_distributed(args.faults)
+        result["device"] = "cpu (2 simulated elastic hosts)"
+        watchdog.disarm()
+        print(json.dumps(result))
+        return
 
     _preflight_probe(args.mode)
     watchdog = _arm_watchdog(args.mode)

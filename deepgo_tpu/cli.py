@@ -55,6 +55,37 @@ def parse_overrides(pairs: list[str]) -> dict:
 def cmd_train(args) -> None:
     if args.resume and args.auto_resume:
         raise SystemExit("--resume and --auto-resume are mutually exclusive")
+    if args.elastic:
+        # elastic multi-host training (docs/robustness.md, "Distributed
+        # failure domains"): every host runs this same command over a
+        # shared run dir; peer death is detected via heartbeat silence,
+        # survivors converge on the latest valid checkpoint, re-mesh, and
+        # resume — --iters stays the TOTAL step target, so re-running the
+        # identical command after any number of host losses converges on
+        # the same final state
+        if not args.auto_resume:
+            raise SystemExit("--elastic requires --auto-resume RUN_DIR "
+                             "(the shared directory hosts converge on)")
+        from .parallel.elastic import ElasticConfig, run_elastic
+
+        ecfg = ElasticConfig(
+            process_id=args.process_id,
+            expected_hosts=args.expected_hosts,
+            heartbeat_interval_s=args.heartbeat_interval,
+            miss_budget=args.miss_budget,
+            straggler_factor=args.straggler_factor,
+            init_deadline_s=args.init_deadline,
+            step_deadline_s=args.step_deadline,
+            max_recoveries=args.max_recoveries,
+            coordinator=args.coordinator,
+            num_processes=args.num_processes,
+        )
+        summary = run_elastic(args.auto_resume, args.iters,
+                              overrides=parse_overrides(args.set), ecfg=ecfg)
+        print(f"elastic host {ecfg.process_id} done at step "
+              f"{summary['final_step']} ({summary['recoveries']} recoveries, "
+              f"{summary['steps_lost_total']} steps rolled back)")
+        return
     if args.auto_resume:
         # elastic restart loop: --iters is the TOTAL step target, so
         # re-running the identical command after any number of kills
@@ -138,6 +169,38 @@ def main(argv=None) -> None:
                         "fresh run there; --set applies to fresh starts "
                         "only")
     p.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
+    p.add_argument("--elastic", action="store_true",
+                   help="multi-host elastic mode (requires --auto-resume): "
+                        "heartbeat liveness, deadline-wrapped bootstrap, "
+                        "checkpoint-coordinated re-mesh recovery on host "
+                        "loss (docs/robustness.md)")
+    p.add_argument("--process-id", type=int, default=0,
+                   help="(--elastic) this host's id in [0, expected-hosts)")
+    p.add_argument("--expected-hosts", type=int, default=1,
+                   help="(--elastic) fleet size whose liveness to watch")
+    p.add_argument("--heartbeat-interval", type=float, default=1.0,
+                   metavar="S", help="(--elastic) expected beat cadence")
+    p.add_argument("--miss-budget", type=int, default=3,
+                   help="(--elastic) beats of silence before a peer is "
+                        "declared lost (budget = interval x this)")
+    p.add_argument("--straggler-factor", type=float, default=3.0,
+                   help="(--elastic) flag hosts slower than this multiple "
+                        "of the fleet median step latency")
+    p.add_argument("--init-deadline", type=float, default=120.0, metavar="S",
+                   help="(--elastic) external-watchdog fuse around the "
+                        "distributed bootstrap (0 disables)")
+    p.add_argument("--step-deadline", type=float, default=0.0, metavar="S",
+                   help="(--elastic) external-watchdog fuse around the "
+                        "FIRST sharded step (compile + first collective; "
+                        "0 disables)")
+    p.add_argument("--max-recoveries", type=int, default=8,
+                   help="(--elastic) bounded recovery budget before a host "
+                        "loss is surfaced instead of absorbed")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="(--elastic) jax.distributed coordinator address "
+                        "(omit on single-host / simulated fleets)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="(--elastic) jax.distributed process count")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a checkpoint")

@@ -117,6 +117,12 @@ class ExperimentConfig:
     # the checkpoint; prefer the env var for kill testing)
     keep_checkpoints: int = 3
     faults: str = ""
+    # elastic multi-host run (parallel/elastic.py): threads the
+    # dist_collective fault site through the step dispatch boundary and
+    # marks the checkpoint as belonging to an elastic fleet; the
+    # per-launch liveness knobs (heartbeat interval, miss budget, ...)
+    # live in ElasticConfig, not here — they must not ride in checkpoints
+    elastic: bool = False
 
     def model_config(self) -> policy_cnn.ModelConfig:
         channels = self.channels
@@ -159,6 +165,12 @@ class Experiment:
         self.initialized = False
         self.params = None
         self.opt_state = None
+        # optional window hook for the elastic layer: called at every
+        # print-window boundary (AFTER metrics/validation/checkpointing)
+        # with (step, window_seconds, window_steps); an exception raised
+        # here — e.g. a typed HostLost from the heartbeat ledger —
+        # propagates out of train() with the loader cleanly closed
+        self.on_window = None
 
     # ---- setup ----
 
@@ -206,16 +218,21 @@ class Experiment:
             _, a_params, a_cfg = load_policy(cfg.anchor_checkpoint)
             anchor = (jax.device_put(a_params, rep), a_cfg,
                       cfg.anchor_weight)
+        # elastic fleets get the dist_collective fault site at the step
+        # dispatch boundary (chaos reach into the multi-host layer)
+        collective_site = "dist_collective" if cfg.elastic else None
         self.train_step = make_train_step(self.model_cfg, self.optimizer,
                                           expand_backend=cfg.expand_backend,
                                           augment=cfg.augment, anchor=anchor,
-                                          wire=self.wire)
+                                          wire=self.wire,
+                                          collective_site=collective_site)
         # the train loop drives this scan-based variant: K steps per device
         # dispatch (see ExperimentConfig.steps_per_call)
         self.train_step_many = make_train_step_many(
             self.model_cfg, self.optimizer,
             expand_backend=cfg.expand_backend, augment=cfg.augment,
-            anchor=anchor, wire=self.wire)
+            anchor=anchor, wire=self.wire,
+            collective_site=collective_site)
         self.eval_step = make_eval_step(self.model_cfg,
                                         expand_backend=cfg.expand_backend,
                                         wire=self.wire)
@@ -410,6 +427,7 @@ class Experiment:
                     window_dt = time.time() - window_t0
                     window_t0 = time.time()
                     sps = window_steps * cfg.batch_size / window_dt
+                    done_steps = window_steps
                     window_steps = 0
                     metrics.write("train", step=self.step, loss=last_loss,
                                   ewma=ewma, samples_per_sec=sps)
@@ -422,6 +440,11 @@ class Experiment:
                               f"accuracy={last_val['accuracy']:.4f}")
                     else:
                         print(f"training {ewma:.4f} (samples per second {sps:.0f})")
+                    # elastic hook LAST, after the periodic checkpoint: a
+                    # HostLost raised here finds the newest checkpoint
+                    # already on disk for the fleet to converge on
+                    if self.on_window is not None:
+                        self.on_window(self.step, window_dt, done_steps)
 
         # fold losses from a final partial print window into the EWMA so
         # runs shorter than print_interval still report one

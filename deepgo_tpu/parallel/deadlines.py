@@ -1,0 +1,146 @@
+"""Deadline-wrapped distributed bootstrap and collectives.
+
+A wedged DCN collective — or a ``jax.distributed.initialize`` dialing a
+coordinator that will never answer — blocks inside a C call holding the
+GIL, so no in-process timer can interrupt it (the PR 1 watchdog postmortem:
+bench watchdog thread never fired; the driver recorded silent rc=124
+timeouts). The only robust deadline is the external-process watchdog
+(``utils/watchdog.py``); this module arms it around the two places a
+multi-host job can wedge forever:
+
+  * **bootstrap** — ``initialize_with_deadline`` retries the coordinator
+    dial with bounded full-jitter backoff (a restarting coordinator is the
+    common transient; a herd of hosts re-dialing in lockstep is the common
+    mistake), wraps reachability failures in a typed
+    ``CoordinatorUnreachable``, and keeps the watchdog armed across the
+    whole retry envelope so a *hanging* (rather than failing) dial still
+    dies loud in seconds.
+  * **the first sharded step** — ``guard_first_call`` arms the watchdog
+    around a step function's first invocation only (compile + the first
+    cross-host collective execution, blocked on to completion inside the
+    guard); later calls pass straight through at zero cost.
+
+The ``dist_init`` fault site fires inside the retried bootstrap attempt
+(transient faults are absorbed by the retry, hard ones surface), giving
+the PR 1 chaos grammar reach into the multi-host layer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+
+from ..utils import watchdog
+from ..utils.retry import retry_with_backoff
+from . import distributed
+from .liveness import CoordinatorUnreachable
+
+
+def _reachability_errors() -> tuple:
+    """Exception types that mean "the coordinator is not answering" (as
+    opposed to a logic error): OS-level connect failures plus the XLA
+    runtime error jax raises on a dead/timed-out coordination service."""
+    errs: list = [ConnectionError, OSError, TimeoutError]
+    try:
+        import jax
+
+        xla_err = getattr(jax.errors, "JaxRuntimeError", None)
+        if xla_err is not None:
+            errs.append(xla_err)
+    except ImportError:  # pragma: no cover - jax is a hard dep elsewhere
+        pass
+    return tuple(errs)
+
+
+@contextlib.contextmanager
+def deadline(label: str, timeout_s: float, diagnostic_json: str | None = None,
+             arm=watchdog.arm):
+    """Arm the external watchdog for the duration of the block; a block
+    that outlives ``timeout_s`` is SIGKILLed (loud, diagnosable) instead of
+    hanging. ``timeout_s <= 0`` disables (yields an unarmed handle)."""
+    wd = (arm(label, timeout_s, diagnostic_json=diagnostic_json)
+          if timeout_s and timeout_s > 0 else watchdog.Watchdog(None))
+    try:
+        yield wd
+    finally:
+        wd.disarm()
+
+
+def initialize_with_deadline(coordinator: str | None = None,
+                             num_processes: int | None = None,
+                             process_id: int | None = None, *,
+                             timeout_s: float = 120.0,
+                             attempts: int = 5,
+                             base_delay: float = 0.5,
+                             max_delay: float = 8.0,
+                             rng=None,
+                             sleep=time.sleep,
+                             arm=watchdog.arm) -> None:
+    """Join the jax.distributed runtime, loudly bounded in time.
+
+    Reachability failures (connect refused/reset, DEADLINE_EXCEEDED from
+    the coordination service, injected ``dist_init`` transients) are
+    retried up to ``attempts`` times with **full-jitter** exponential
+    backoff — every host observed the same coordinator restart at the same
+    instant, and deterministic delays would re-synchronize the herd into
+    thundering re-dials. The final failure raises a typed
+    ``CoordinatorUnreachable`` naming the coordinator. A dial that *hangs*
+    instead of failing is SIGKILLed by the external watchdog after
+    ``timeout_s`` (0 disables). Hard injected faults (``dist_init:fail@N``)
+    are logic-level and propagate immediately, un-retried.
+
+    Single-process runs (no coordinator, ``num_processes=1``) stay the
+    no-op they always were — minus the armed watchdog, which still
+    protects the (local, instant) bootstrap path at negligible cost.
+    """
+    reach = _reachability_errors()
+
+    def attempt() -> None:
+        try:
+            distributed.initialize(coordinator, num_processes, process_id)
+        except reach as e:
+            raise CoordinatorUnreachable(
+                f"coordinator {coordinator or '<auto>'} unreachable: "
+                f"{type(e).__name__}: {e}") from e
+
+    with deadline(f"dist-init({coordinator or 'local'})", timeout_s, arm=arm):
+        retry_with_backoff(
+            attempt,
+            attempts=attempts,
+            base_delay=base_delay,
+            max_delay=max_delay,
+            retry_on=(CoordinatorUnreachable,),
+            jitter=True,
+            rng=rng,
+            sleep=sleep,
+        )
+
+
+def guard_first_call(fn, label: str, timeout_s: float, arm=watchdog.arm):
+    """Wrap a (jitted) step function so its FIRST call runs under the
+    external watchdog and is blocked on to completion.
+
+    The first sharded step is where a broken multi-host job wedges: the
+    compile barrier and the first DCN all-reduce both require every
+    participant, so one dead host turns the call into a silent multi-minute
+    hang. Blocking on the outputs inside the guard makes the deadline cover
+    *execution*, not just dispatch (async dispatch returns before the
+    collective runs). Every later call passes through untouched — steady
+    -state steps are watched by the heartbeat ledger, not a per-call
+    watchdog."""
+    state = {"first_done": False}
+
+    @functools.wraps(fn)
+    def guarded(*args, **kwargs):
+        if state["first_done"]:
+            return fn(*args, **kwargs)
+        import jax
+
+        with deadline(label, timeout_s, arm=arm):
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+        state["first_done"] = True
+        return out
+
+    return guarded

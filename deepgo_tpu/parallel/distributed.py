@@ -11,6 +11,15 @@ Single-host degenerates cleanly: ``initialize()`` is a no-op and
 ``hybrid_mesh`` equals ``make_mesh``. Multi-host batches are assembled with
 ``per_host_batch`` -> ``jax.make_array_from_process_local_data`` so each
 host feeds only its own shard (no cross-host host-side traffic).
+
+Resilience (PR 4): the bootstrap carries the ``dist_init`` fault site and
+is normally entered through ``deadlines.initialize_with_deadline`` (bounded
+full-jitter retry + external watchdog); ``hybrid_mesh`` accepts a
+``processes`` filter so the elastic recovery path (``parallel/elastic.py``)
+can re-mesh over the surviving process set after a ``HostLost``; and
+``per_host_batch`` takes the surviving process count and raises a typed
+``ConfigError`` (asserts vanish under ``python -O``) when the global batch
+does not divide.
 """
 
 from __future__ import annotations
@@ -18,6 +27,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from ..utils import faults
+from .liveness import ConfigError
 from .mesh import make_mesh
 
 
@@ -26,8 +37,14 @@ def initialize(coordinator: str | None = None, num_processes: int | None = None,
     """Join (or skip, when single-process) the JAX distributed runtime.
 
     On Cloud TPU pods the three arguments are auto-detected from the
-    metadata server; pass them explicitly elsewhere.
+    metadata server; pass them explicitly elsewhere. The ``dist_init``
+    fault site fires first — before the single-process short-circuit — so
+    the chaos grammar reaches the bootstrap on any topology; prefer
+    ``deadlines.initialize_with_deadline``, which absorbs transient
+    faults with bounded full-jitter retry and arms the external watchdog
+    against a hanging (rather than failing) dial.
     """
+    faults.check("dist_init")
     if num_processes == 1 or (num_processes is None and coordinator is None
                               and jax.process_count() == 1):
         return
@@ -38,25 +55,57 @@ def initialize(coordinator: str | None = None, num_processes: int | None = None,
     )
 
 
-def hybrid_mesh(n_model: int = 1):
+def hybrid_mesh(n_model: int = 1, devices=None, processes=None):
     """("data", "model") mesh over every device of every process, with the
-    data axis ordered hosts-major so intra-host neighbors stay on ICI."""
-    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    data axis ordered hosts-major so intra-host neighbors stay on ICI.
+
+    ``processes`` restricts the mesh to a set of process indices — the
+    re-mesh entry point after a host loss: surviving hosts rebuild the
+    mesh over exactly the surviving process set and training continues on
+    the shrunken data axis. ``devices`` overrides device discovery (tests
+    exercise multi-host layouts with fake device objects)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if processes is not None:
+        processes = set(processes)
+        devices = [d for d in devices if d.process_index in processes]
+        if not devices:
+            raise ConfigError(
+                f"re-mesh over processes {sorted(processes)} matches no "
+                f"devices — every surviving host must own at least one")
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    if n_model < 1 or len(devices) % n_model != 0:
+        raise ConfigError(
+            f"hybrid mesh: {len(devices)} devices do not divide over "
+            f"n_model={n_model}")
     n_data = len(devices) // n_model
     return make_mesh(n_data, n_model, devices=devices)
 
 
-def per_host_batch(global_batch: int) -> int:
-    """How many samples this process should contribute per step."""
-    assert global_batch % jax.process_count() == 0
-    return global_batch // jax.process_count()
+def per_host_batch(global_batch: int, process_count: int | None = None) -> int:
+    """How many samples this process should contribute per step.
+
+    ``process_count`` defaults to ``jax.process_count()``; the elastic
+    recovery path passes the *surviving* count so the global batch is
+    re-balanced over the shrunken fleet after a re-mesh."""
+    n = jax.process_count() if process_count is None else process_count
+    if n < 1:
+        raise ConfigError(f"process_count must be >= 1, got {n}")
+    if global_batch % n != 0:
+        raise ConfigError(
+            f"global batch {global_batch} does not divide over {n} "
+            f"processes ({global_batch} % {n} = {global_batch % n}); pick a "
+            f"global batch that is a multiple of the process count")
+    return global_batch // n
 
 
 def global_array_from_local(mesh, local_batch: dict) -> dict:
     """Assemble a globally-sharded batch from this host's local samples
-    (each process calls this with its own shard)."""
+    (each process calls this with its own shard). The ``dist_collective``
+    fault site fires at this host->global boundary — the first place a
+    batch becomes a cross-host object."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    faults.check("dist_collective")
     sharding = NamedSharding(mesh, P("data"))
     return {
         k: jax.make_array_from_process_local_data(sharding, np.asarray(v))

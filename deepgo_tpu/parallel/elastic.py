@@ -1,0 +1,264 @@
+"""Elastic multi-host training: checkpoint-coordinated re-mesh recovery.
+
+The recovery contract (docs/robustness.md, "Distributed failure domains"):
+when a participating host dies, every survivor independently
+
+  1. **detects** the loss — its heartbeat silence exceeds the miss budget
+     (``liveness.HeartbeatLedger``), raised as a typed ``HostLost`` out of
+     the training loop's window hook;
+  2. **converges** on the newest valid checkpoint in the shared run
+     directory (``checkpoint.find_latest_valid`` — PR 1's elastic resume,
+     which already skips corrupt candidates), discarding its own
+     in-memory state: survivors must agree on *one* restart point, and
+     the checkpoint is the only state they provably share;
+  3. **re-meshes** over the surviving process set (``remesh``) and
+     re-balances the global batch (``per_host_batch`` with the surviving
+     count);
+  4. **resumes** — and because the synchronous data stream is
+     step-indexed (``loader.step_rng``: the batch for step t is a pure
+     function of (seed, t)), the continuation is bit-exact against an
+     uninterrupted run over the same step indices, re-mesh or not. The
+     acceptance test asserts exactly this.
+
+Steps are lost (rollback to the checkpoint), never corrupted — the same
+trade PR 1 made for single-host kills. Recovery latency and steps-lost are
+measured and reported (``ELASTIC_RECOVERY`` / ``ELASTIC_DONE`` JSON lines
+on stdout; ``elastic-<host>.jsonl`` metrics in the run directory), so the
+cost of surviving failure is a number, not a hope.
+
+On this container multi-host is *simulated*: each "host" is a process with
+its own local device world, coordinated purely through the shared
+filesystem (heartbeats + checkpoints) — the CPU backend has no
+cross-process collectives ("Multiprocess computations aren't implemented on
+the CPU backend"), and a live jax.distributed runtime cannot shrink
+in-process anyway. On a real pod the same loop applies per host, with the
+relaunch re-entering through the deadline-wrapped bootstrap
+(``deadlines.initialize_with_deadline``); checkpoint convergence is what
+makes that relaunch safe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass
+
+import jax
+
+from ..utils.metrics import MetricsWriter
+from .deadlines import guard_first_call, initialize_with_deadline
+from .distributed import hybrid_mesh, per_host_batch
+from .liveness import (ConfigError, HeartbeatLedger, HeartbeatWriter,
+                       HostLost)
+
+
+@dataclass(frozen=True)
+class ElasticConfig:
+    """Infrastructure knobs for one elastic host (CLI: ``train --elastic``).
+
+    These are per-launch facts — which host am I, who else should exist,
+    how patient is liveness — so they live here, not in ExperimentConfig
+    (which rides inside checkpoints and must describe the *model run*)."""
+
+    process_id: int = 0
+    expected_hosts: int = 1
+    heartbeat_interval_s: float = 1.0
+    miss_budget: int = 3
+    straggler_factor: float = 3.0
+    min_straggler_beats: int = 3
+    init_deadline_s: float = 120.0
+    step_deadline_s: float = 0.0   # 0 = no watchdog around the first step
+    max_recoveries: int = 8
+    coordinator: str | None = None
+    num_processes: int | None = None
+    heartbeat_dir: str = ""        # default: <run_dir>/heartbeats
+
+
+def remesh(n_model: int, survivors: set[int]):
+    """The mesh after a host loss.
+
+    Real multi-process runtime: the hybrid mesh restricted to surviving
+    process indices (hosts-major ordering preserved). Simulated hosts
+    (single jax process): the local device world IS the surviving world,
+    so the full local hybrid mesh. Raises ConfigError when the surviving
+    set owns no devices."""
+    if jax.process_count() > 1:
+        alive = sorted(p for p in survivors if p < jax.process_count())
+        return hybrid_mesh(n_model, processes=alive)
+    return hybrid_mesh(n_model)
+
+
+def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None,
+                ecfg: ElasticConfig = ElasticConfig(), clock=time.time,
+                log=None) -> dict:
+    """Train to ``total_iters`` total steps, surviving peer-host death.
+
+    Each participating host runs this over the same ``run_dir`` (shared
+    filesystem). Semantics match ``cli train --auto-resume``: ``total_iters``
+    is the TOTAL step target, so re-running the identical command after any
+    number of kills — of this host or its peers — converges on the same
+    final state. Returns the summary dict also printed as the
+    ``ELASTIC_DONE`` JSON line."""
+    from ..experiments import Experiment
+
+    if log is None:
+        def log(msg):
+            print(msg, file=sys.stderr, flush=True)
+
+    if ecfg.expected_hosts < 1:
+        raise ConfigError(
+            f"expected_hosts must be >= 1, got {ecfg.expected_hosts}")
+    if not (0 <= ecfg.process_id < ecfg.expected_hosts):
+        raise ConfigError(
+            f"process_id {ecfg.process_id} outside expected_hosts "
+            f"{ecfg.expected_hosts}")
+
+    # bootstrap: deadline-wrapped, retried, typed (a no-op single-process,
+    # but the dist_init fault site and the watchdog cover it either way)
+    initialize_with_deadline(ecfg.coordinator, ecfg.num_processes,
+                             ecfg.process_id, timeout_s=ecfg.init_deadline_s)
+
+    hb_dir = ecfg.heartbeat_dir or os.path.join(run_dir, "heartbeats")
+    writer = HeartbeatWriter(hb_dir, ecfg.process_id, clock=clock)
+    ledger = HeartbeatLedger(hb_dir, interval_s=ecfg.heartbeat_interval_s,
+                             miss_budget=ecfg.miss_budget, clock=clock,
+                             log=log)
+    survivors = set(range(ecfg.expected_hosts))
+    metrics = MetricsWriter(os.path.join(
+        run_dir, f"elastic-{ecfg.process_id:04d}.jsonl"))
+    metrics.write("elastic_start", host=ecfg.process_id,
+                  expected_hosts=ecfg.expected_hosts,
+                  budget_s=ledger.budget_s)
+
+    recoveries: list[dict] = []
+    pending_loss: dict | None = None
+    exp = None
+    # fresh starts must record that this run is elastic (the flag rides in
+    # the checkpoint config and threads the dist_collective fault site
+    # through the jitted steps); resumes take the stored config as always
+    overrides = dict(overrides or {})
+    overrides["elastic"] = True
+    try:
+        while True:
+            exp = Experiment.auto_resume(run_dir, overrides=dict(overrides),
+                                         log=log)
+            if pending_loss is not None:
+                # finalize the recovery record now that we know where the
+                # fleet converged (the checkpoint step survives; everything
+                # the dead host's peers computed past it is rolled back)
+                now = clock()
+                rec = dict(pending_loss)
+                rec.update(
+                    resumed_step=exp.step,
+                    steps_lost=max(0, rec["step_at_detection"] - exp.step),
+                    recovery_latency_s=now - rec["last_seen"],
+                    detect_latency_s=rec["detected_at"] - rec["last_seen"],
+                    survivors=sorted(survivors),
+                )
+                del rec["detected_at"]
+                recoveries.append(rec)
+                metrics.write("recovery", **rec)
+                print("ELASTIC_RECOVERY " + json.dumps(rec), flush=True)
+                pending_loss = None
+            remaining = total_iters - exp.step
+            if remaining <= 0:
+                log(f"elastic host {ecfg.process_id}: step {exp.step} already "
+                    f"meets --iters {total_iters}; nothing to do")
+                summary = {"final_step": exp.step, "final_ewma": exp.ewma}
+                break
+            if not exp.initialized:
+                exp.init()
+            if ecfg.step_deadline_s > 0:
+                # the first sharded step (compile + first collective) is
+                # where a broken fleet wedges; arm the external watchdog
+                # around exactly that call
+                exp.train_step = guard_first_call(
+                    exp.train_step, f"first-step(host {ecfg.process_id})",
+                    ecfg.step_deadline_s)
+                exp.train_step_many = guard_first_call(
+                    exp.train_step_many,
+                    f"first-step-many(host {ecfg.process_id})",
+                    ecfg.step_deadline_s)
+
+            peers = survivors - {ecfg.process_id}
+
+            def on_window(step: int, window_dt: float, window_steps: int) -> None:
+                writer.beat(step, step_latency_s=window_dt / max(1, window_steps))
+                if not peers:
+                    return
+                ledger.poll()
+                ledger.check_liveness(peers)  # raises HostLost
+                for s in ledger.straggler_report(ecfg.straggler_factor,
+                                                 ecfg.min_straggler_beats):
+                    log(f"elastic host {ecfg.process_id}: {s}")
+                    metrics.write("straggler", host=s.process_id,
+                                  latency_s=s.latency_s,
+                                  fleet_median_s=s.fleet_median_s)
+
+            exp.on_window = on_window
+            writer.beat(exp.step)  # registration / resume announcement
+            try:
+                run_summary = exp.run(remaining)
+                path = exp.save()
+                summary = {"final_step": exp.step,
+                           "final_ewma": run_summary["final_ewma"],
+                           "samples_per_sec": run_summary["samples_per_sec"],
+                           "checkpoint": path}
+                break
+            except HostLost as e:
+                detected_at = clock()
+                if len(recoveries) >= ecfg.max_recoveries:
+                    log(f"elastic host {ecfg.process_id}: recovery budget "
+                        f"({ecfg.max_recoveries}) exhausted; surfacing {e}")
+                    raise
+                survivors.discard(e.process_id)
+                if not survivors:
+                    raise  # cannot happen for a live host; defensive
+                log(f"elastic host {ecfg.process_id}: {e}; converging on the "
+                    f"latest valid checkpoint and re-meshing over "
+                    f"{sorted(survivors)}")
+                mesh = remesh(exp.config.tensor_parallel, survivors)
+                try:
+                    local_batch = per_host_batch(exp.config.batch_size,
+                                                 process_count=len(survivors))
+                    log(f"elastic host {ecfg.process_id}: re-mesh "
+                        f"{dict(mesh.shape)}; per-host batch -> {local_batch}")
+                except ConfigError as ce:
+                    # a non-dividing batch over the shrunken fleet is a real
+                    # re-balance constraint; surviving with padding is the
+                    # loader's problem, not a reason to abandon recovery
+                    local_batch = None
+                    log(f"elastic host {ecfg.process_id}: {ce}")
+                pending_loss = {
+                    "event": "host_lost",
+                    "process_id": e.process_id,
+                    "last_seen": e.last_seen,
+                    "silent_for_s": e.silent_for_s,
+                    "budget_s": e.budget_s,
+                    "last_step": e.last_step,
+                    "step_at_detection": exp.step,
+                    "detected_at": detected_at,
+                    "per_host_batch": local_batch,
+                }
+                metrics.write("host_lost", **{k: v for k, v in
+                                              pending_loss.items()
+                                              if k != "event"})
+                continue
+
+        summary.update(
+            host=ecfg.process_id,
+            survivors=sorted(survivors),
+            recoveries=len(recoveries),
+            steps_lost_total=sum(r["steps_lost"] for r in recoveries),
+            recovery_latency_s=[round(r["recovery_latency_s"], 3)
+                                for r in recoveries],
+            heartbeats=writer.beats,
+        )
+        metrics.write("elastic_done", **{k: v for k, v in summary.items()
+                                         if k != "checkpoint"})
+        print("ELASTIC_DONE " + json.dumps(summary), flush=True)
+        return summary
+    finally:
+        metrics.close()

@@ -25,13 +25,16 @@ def make_mesh(n_data: int | None = None, n_model: int = 1,
               devices=None) -> Mesh:
     """A ("data", "model") mesh. Defaults to all local devices on the data
     axis; n_data=1, n_model=1 gives the degenerate single-device mesh."""
+    from .liveness import ConfigError
+
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
         n_data = len(devices) // n_model
     used = n_data * n_model
-    assert used <= len(devices), (
-        f"mesh {n_data}x{n_model} needs {used} devices, have {len(devices)}"
-    )
+    if used > len(devices):  # typed, not assert: must fail under python -O
+        raise ConfigError(
+            f"mesh {n_data}x{n_model} needs {used} devices, have {len(devices)}"
+        )
     grid = np.array(devices[:used]).reshape(n_data, n_model)
     return Mesh(grid, axis_names=("data", "model"))
 

@@ -21,7 +21,27 @@ import jax.numpy as jnp
 
 from ..models import policy_cnn
 from ..ops import expand_planes, get_expand_fn
+from ..utils import faults
 from .optimizers import Optimizer
+
+
+def _with_collective_site(step, site: str | None):
+    """Host-side fault point at the step-dispatch boundary.
+
+    For an elastic multi-host run every dispatch is a collective (the
+    gradient all-reduce rides inside the fused program), so this is where
+    the ``dist_collective`` chaos site lives: OUTSIDE the jit (fault
+    injection is host control flow, never traced), right before the
+    dispatch that would hang on a dead peer. ``site=None`` returns the
+    step untouched — single-host training pays nothing."""
+    if site is None:
+        return step
+
+    def checked(*args):
+        faults.check(site)
+        return step(*args)
+
+    return checked
 
 
 def nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -82,7 +102,8 @@ def _one_step(params, opt_state, batch, cfg, optimizer, expand_planes,
 
 def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
                     expand_backend: str = "xla", augment: bool = False,
-                    anchor=None, wire: str = "packed"):
+                    anchor=None, wire: str = "packed",
+                    collective_site: str | None = None):
     """Returns step(params, opt_state, batch) -> (params, opt_state, loss).
 
     With ``augment=True`` the batch carries a per-sample "sym" entry and the
@@ -94,6 +115,10 @@ def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
     reference policy — the guard against the distribution collapse the
     expert-iteration study measured (RESULTS.md). The anchor params are
     closed over and become constants of the fused program.
+
+    ``collective_site`` names a fault point checked host-side before each
+    dispatch (elastic multi-host runs pass "dist_collective" so the chaos
+    grammar reaches the collective boundary); None costs nothing.
     """
     expand_planes = get_expand_fn(expand_backend)
 
@@ -102,12 +127,13 @@ def make_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
         return _one_step(params, opt_state, batch, cfg, optimizer,
                          expand_planes, augment, anchor, wire)
 
-    return step
+    return _with_collective_site(step, collective_site)
 
 
 def make_train_step_many(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
                          expand_backend: str = "xla", augment: bool = False,
-                         anchor=None, wire: str = "packed"):
+                         anchor=None, wire: str = "packed",
+                         collective_site: str | None = None):
     """Returns step(params, opt_state, batches) -> (params, opt_state, losses).
 
     ``batches`` is a superbatch: the same dict as ``make_train_step`` takes
@@ -134,7 +160,7 @@ def make_train_step_many(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
             body, (params, opt_state), batches)
         return params, opt_state, losses
 
-    return step
+    return _with_collective_site(step, collective_site)
 
 
 def make_eval_step(cfg: policy_cnn.ModelConfig, expand_backend: str = "xla",

@@ -20,6 +20,19 @@ programmatically / via ``ExperimentConfig.faults``) and consulted at named
   serving_forward  inside the serving dispatch, alongside the jitted
                    forward — an injected fault here fails ONE coalesced
                    batch (BatchDispatchError; the poison-isolation path)
+  dist_init        the multi-host bootstrap (parallel.distributed.initialize),
+                   before the coordinator dial — transients are absorbed by
+                   the deadline-wrapped full-jitter retry
+                   (parallel.deadlines.initialize_with_deadline), hard
+                   faults surface un-retried
+  dist_collective  host-side at the elastic step-dispatch boundary (the
+                   gradient all-reduce rides inside the dispatched program)
+                   and at global_array_from_local — where a batch becomes a
+                   cross-host object
+  heartbeat        inside HeartbeatWriter.beat (parallel.liveness) —
+                   transient write faults are retried, hard ones logged and
+                   absorbed (the peers' miss budget exists precisely to
+                   tolerate missed beats)
 
 Grammar (comma-separated ``site:kind@arg`` specs):
 

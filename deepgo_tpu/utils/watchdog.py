@@ -17,9 +17,21 @@ watchdog is the TPU-relay-era equivalent.
 
 from __future__ import annotations
 
+import math
 import os
 import subprocess
 import sys
+
+
+def _poll_count(timeout_s: float) -> int:
+    """1-second child polls needed to cover ``timeout_s``, rounded UP.
+
+    ``int()`` truncation made ``timeout_s=1.5`` fire after ~1s — an early
+    kill is strictly worse than a late one for a watchdog (it murders a
+    healthy process), so fractional budgets always round away from the
+    trigger. The minimum of one poll keeps a zero/negative budget from
+    producing an instant kill loop."""
+    return max(1, math.ceil(float(timeout_s)))
 
 
 class Watchdog:
@@ -56,7 +68,7 @@ def arm(label: str, timeout_s: float = 120.0,
         "import os, signal, sys, time",
         f"ppid = {os.getpid()}",
         f"label = {str(label)!r}",
-        f"for _ in range(max(1, int({float(timeout_s)!r}))):",
+        f"for _ in range({_poll_count(timeout_s)}):",
         "    time.sleep(1)",
         "    if os.getppid() != ppid:",
         "        sys.exit(0)",

@@ -20,18 +20,25 @@ PORT = sys.argv[2]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(
-    coordinator_address=f"127.0.0.1:{PORT}",
+
+# PR 4: the production entry — watchdog-armed, full-jitter-retried dial
+# (parallel/deadlines.py) — so this harness exercises the same bootstrap a
+# pod host uses instead of the raw jax.distributed.initialize
+from deepgo_tpu.parallel.deadlines import initialize_with_deadline  # noqa: E402
+
+initialize_with_deadline(
+    f"127.0.0.1:{PORT}",
     num_processes=2,
     process_id=PROC_ID,
+    timeout_s=180.0,
 )
 
 import numpy as np  # noqa: E402
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from deepgo_tpu.models import ModelConfig, init  # noqa: E402
 from deepgo_tpu.parallel import distributed, replicated_sharding  # noqa: E402

@@ -1,0 +1,160 @@
+"""Deadline-wrapped bootstrap and first-step guard (parallel/deadlines.py).
+
+Everything is driven with injected arm/sleep/rng fakes — no real watchdog
+children are spawned and no test sleeps; the one real-watchdog integration
+path (arm + SIGKILL) is pinned in test_watchdog.py / test_graft_entry.py.
+"""
+
+import pytest
+
+from deepgo_tpu.parallel import deadlines, distributed
+from deepgo_tpu.parallel.liveness import CoordinatorUnreachable
+from deepgo_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeArm:
+    """Records arm/disarm pairs; stands in for utils.watchdog.arm."""
+
+    def __init__(self):
+        self.armed: list[tuple] = []
+        self.disarmed = 0
+
+    def __call__(self, label, timeout_s, diagnostic_json=None):
+        self.armed.append((label, timeout_s))
+        outer = self
+
+        class Handle:
+            def disarm(self):
+                outer.disarmed += 1
+
+        return Handle()
+
+
+def test_deadline_arms_and_always_disarms():
+    arm = FakeArm()
+    with deadlines.deadline("claim", 7.5, arm=arm):
+        assert arm.armed == [("claim", 7.5)]
+        assert arm.disarmed == 0
+    assert arm.disarmed == 1
+    # the fuse must not survive an exception either
+    with pytest.raises(RuntimeError):
+        with deadlines.deadline("boom", 2.0, arm=arm):
+            raise RuntimeError("x")
+    assert arm.disarmed == 2
+
+
+def test_deadline_zero_timeout_disables():
+    arm = FakeArm()
+    with deadlines.deadline("off", 0.0, arm=arm):
+        pass
+    with deadlines.deadline("off", -1.0, arm=arm):
+        pass
+    assert arm.armed == []  # nothing armed, nothing to kill
+
+
+def test_initialize_single_process_is_still_a_noop():
+    arm = FakeArm()
+    deadlines.initialize_with_deadline(num_processes=1, timeout_s=30.0,
+                                       arm=arm)
+    # the watchdog covered the (instant) local path and was disarmed
+    assert arm.armed and arm.disarmed == len(arm.armed)
+
+
+def test_unreachable_coordinator_retried_with_full_jitter(monkeypatch):
+    calls = {"n": 0}
+
+    def refuse_twice(coordinator, num_processes, process_id):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionRefusedError("dial 127.0.0.1:1 refused")
+
+    monkeypatch.setattr(distributed, "initialize", refuse_twice)
+    slept: list[float] = []
+
+    class Rng:  # deterministic full-jitter draws at the top of the envelope
+        def uniform(self, lo, hi):
+            return hi
+
+    deadlines.initialize_with_deadline(
+        "127.0.0.1:1", 2, 0, timeout_s=60.0, attempts=5, base_delay=0.5,
+        max_delay=8.0, rng=Rng(), sleep=slept.append, arm=FakeArm())
+    assert calls["n"] == 3
+    # full-jitter: each sleep drawn from U(0, base * 2**k); Rng pins the top
+    assert slept == [0.5, 1.0]
+
+
+def test_unreachable_coordinator_exhausts_typed(monkeypatch):
+    def always_refuse(coordinator, num_processes, process_id):
+        raise ConnectionRefusedError("nobody home")
+
+    monkeypatch.setattr(distributed, "initialize", always_refuse)
+    arm = FakeArm()
+    with pytest.raises(CoordinatorUnreachable, match="10.0.0.7:1234"):
+        deadlines.initialize_with_deadline(
+            "10.0.0.7:1234", 2, 0, timeout_s=60.0, attempts=3,
+            sleep=lambda s: None, arm=arm)
+    # ONE watchdog spans the whole retry envelope, and it was disarmed
+    assert arm.armed == [("dist-init(10.0.0.7:1234)", 60.0)]
+    assert arm.disarmed == 1
+
+
+def test_dist_init_transients_absorbed_by_retry():
+    faults.install("dist_init:transient@2")
+    deadlines.initialize_with_deadline(num_processes=1, timeout_s=30.0,
+                                       sleep=lambda s: None, arm=FakeArm())
+    # both injected transients absorbed; the bootstrap completed
+
+
+def test_dist_init_hard_fault_surfaces_unretried():
+    faults.install("dist_init:fail@1")
+    slept: list[float] = []
+    with pytest.raises(faults.InjectedFailure):
+        deadlines.initialize_with_deadline(
+            num_processes=1, timeout_s=30.0, sleep=slept.append,
+            arm=FakeArm())
+    assert slept == []  # a logic-level fault is not a dial to re-try
+
+
+def test_guard_first_call_arms_exactly_once():
+    import jax.numpy as jnp
+
+    arm = FakeArm()
+    calls = {"n": 0}
+
+    def step(x):
+        calls["n"] += 1
+        return jnp.asarray(x) * 2
+
+    guarded = deadlines.guard_first_call(step, "first-step", 30.0, arm=arm)
+    assert float(guarded(3)) == 6.0
+    assert arm.armed == [("first-step", 30.0)] and arm.disarmed == 1
+    for x in (4, 5):
+        guarded(x)
+    assert calls["n"] == 3
+    assert arm.armed == [("first-step", 30.0)]  # later calls pass through
+
+
+def test_guard_first_call_failed_first_call_stays_guarded():
+    arm = FakeArm()
+    attempts = {"n": 0}
+
+    def step():
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise RuntimeError("compile blew up")
+        return 1
+
+    guarded = deadlines.guard_first_call(step, "first", 10.0, arm=arm)
+    with pytest.raises(RuntimeError):
+        guarded()
+    assert arm.disarmed == 1  # no leaked fuse
+    assert guarded() == 1     # the RETRY is still the guarded first call
+    assert len(arm.armed) == 2

@@ -1,0 +1,336 @@
+"""Elastic multi-host training (parallel/elastic.py): liveness-driven
+detection, checkpoint convergence, re-mesh, and bit-exact resume.
+
+The fast cases simulate a peer host through its heartbeat file alone — the
+orchestration under test (detect -> converge -> re-mesh -> resume) never
+needs a live second process. The slow case is the real thing: two
+``cli train --elastic`` processes over one shared run directory, one
+SIGKILLed mid-training by the ``kill`` fault site.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+from deepgo_tpu.data.transcribe import transcribe_split
+from deepgo_tpu.experiments import Experiment, ExperimentConfig
+from deepgo_tpu.experiments import checkpoint as ckpt
+from deepgo_tpu.parallel import elastic
+from deepgo_tpu.parallel.elastic import ElasticConfig, run_elastic
+from deepgo_tpu.parallel.liveness import ConfigError, HeartbeatWriter
+from deepgo_tpu.utils import faults
+from deepgo_tpu.utils.metrics import read_jsonl
+
+
+@pytest.fixture(scope="module")
+def data_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("processed")
+    for split in ("validation", "test"):
+        transcribe_split(
+            os.path.join(REPO_ROOT, "data/sgf", split),
+            str(root / split),
+            workers=1,
+            verbose=False,
+        )
+    return str(root)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def tiny_overrides(data_root, **kw):
+    defaults = dict(
+        name="elastic-test",
+        num_layers=2,
+        channels=8,
+        batch_size=8,
+        rate=0.05,
+        validation_size=16,
+        validation_interval=5,
+        print_interval=5,
+        data_root=data_root,
+        train_split="validation",
+        validation_split="test",
+        test_split="test",
+        loader_threads=0,
+        data_parallel=2,
+        keep_checkpoints=0,
+    )
+    defaults.update(kw)
+    return defaults
+
+
+def leaves(tree):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+# ---- re-mesh ----
+
+
+def test_remesh_single_process_spans_local_world():
+    import jax
+
+    mesh = elastic.remesh(1, survivors={0})
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.devices.size == len(jax.devices())
+    mesh2 = elastic.remesh(2, survivors={0, 1})
+    assert mesh2.shape["model"] == 2
+
+
+# ---- config validation (typed, raised before any training state) ----
+
+
+def test_elastic_config_validation_is_typed(tmp_path):
+    with pytest.raises(ConfigError, match="expected_hosts"):
+        run_elastic(str(tmp_path), 5,
+                    ecfg=ElasticConfig(expected_hosts=0))
+    with pytest.raises(ConfigError, match="process_id"):
+        run_elastic(str(tmp_path), 5,
+                    ecfg=ElasticConfig(process_id=2, expected_hosts=2))
+
+
+def test_cli_elastic_requires_auto_resume():
+    from deepgo_tpu import cli
+
+    with pytest.raises(SystemExit, match="auto-resume"):
+        cli.main(["train", "--iters", "5", "--elastic"])
+
+
+# ---- single-host elastic: completion, observability, idempotence ----
+
+
+def test_single_host_elastic_completes_and_is_idempotent(
+        data_root, tmp_path, capsys):
+    run_dir = str(tmp_path / "run")
+    ecfg = ElasticConfig(process_id=0, expected_hosts=1,
+                         heartbeat_interval_s=0.2, miss_budget=3)
+    summary = run_elastic(run_dir, 10,
+                          overrides=tiny_overrides(data_root), ecfg=ecfg)
+    assert summary["final_step"] == 10
+    assert summary["recoveries"] == 0
+    assert summary["steps_lost_total"] == 0
+    assert summary["survivors"] == [0]
+    assert summary["heartbeats"] >= 1
+    # observable: heartbeat file, elastic metrics stream, DONE stdout line
+    assert os.path.exists(os.path.join(run_dir, "heartbeats",
+                                       "heartbeat-0000.json"))
+    events = [r["kind"] for r in
+              read_jsonl(os.path.join(run_dir, "elastic-0000.jsonl"))]
+    assert events[0] == "elastic_start" and "elastic_done" in events
+    done = [l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("ELASTIC_DONE ")]
+    assert json.loads(done[-1].split(" ", 1)[1])["final_step"] == 10
+
+    # --iters is the TOTAL target: a re-run of the same command is a no-op
+    again = run_elastic(run_dir, 10,
+                        overrides=tiny_overrides(data_root), ecfg=ecfg)
+    assert again["final_step"] == 10 and again["recoveries"] == 0
+
+
+# ---- host loss: detection, convergence, recovery accounting ----
+
+
+def test_host_loss_before_any_checkpoint_recovers_fresh(
+        data_root, tmp_path, capsys):
+    """A peer that beat once and went silent is detected at the first
+    liveness check; with no checkpoint on disk yet the survivors converge
+    on a FRESH start — steps since step 0 are the rollback cost."""
+    run_dir = str(tmp_path / "run")
+    hb_dir = os.path.join(run_dir, "heartbeats")
+    HeartbeatWriter(hb_dir, 1).beat(0)  # the peer's only sign of life
+
+    ecfg = ElasticConfig(process_id=0, expected_hosts=2,
+                         heartbeat_interval_s=0.05, miss_budget=4)
+    # validation_interval=10: the first window (step 5) has NO checkpoint
+    summary = run_elastic(
+        run_dir, 15,
+        overrides=tiny_overrides(data_root, validation_interval=10),
+        ecfg=ecfg)
+    assert summary["final_step"] == 15
+    assert summary["recoveries"] == 1
+    assert summary["survivors"] == [0]
+    assert summary["steps_lost_total"] == 5  # detection at 5, restart at 0
+
+    rec_lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("ELASTIC_RECOVERY ")]
+    rec = json.loads(rec_lines[0].split(" ", 1)[1])
+    assert rec["process_id"] == 1
+    assert rec["step_at_detection"] == 5
+    assert rec["resumed_step"] == 0
+    assert rec["steps_lost"] == 5
+    assert rec["silent_for_s"] > ecfg.heartbeat_interval_s * ecfg.miss_budget
+    assert rec["survivors"] == [0]
+    events = read_jsonl(os.path.join(run_dir, "elastic-0000.jsonl"))
+    kinds = [r["kind"] for r in events]
+    assert kinds.count("host_lost") == 1 and kinds.count("recovery") == 1
+
+
+def test_recovery_converges_on_checkpoint_bit_exact(data_root, tmp_path):
+    """The acceptance property in-process: detection lands right after the
+    step-5 checkpoint, the survivor converges on it, re-meshes, and the
+    continuation is bit-identical to an uninterrupted run over the same
+    step indices (loader.step_rng's guarantee, asserted across a re-mesh)."""
+    lossy = str(tmp_path / "lossy")
+    HeartbeatWriter(os.path.join(lossy, "heartbeats"), 1).beat(0)
+    summary = run_elastic(
+        lossy, 15, overrides=tiny_overrides(data_root),
+        ecfg=ElasticConfig(process_id=0, expected_hosts=2,
+                           heartbeat_interval_s=0.05, miss_budget=4))
+    assert summary["recoveries"] == 1
+    assert summary["steps_lost_total"] == 0  # checkpoint@5, detection@5
+    assert summary["final_step"] == 15
+
+    clean = str(tmp_path / "clean")
+    ref = run_elastic(clean, 15, overrides=tiny_overrides(data_root),
+                      ecfg=ElasticConfig(process_id=0, expected_hosts=1))
+    assert ref["recoveries"] == 0
+
+    meta_l, p_l, o_l = ckpt.load_checkpoint(summary["checkpoint"])
+    meta_c, p_c, o_c = ckpt.load_checkpoint(ref["checkpoint"])
+    assert meta_l["step"] == meta_c["step"] == 15
+    for a, b in zip(p_l + o_l, p_c + o_c):
+        np.testing.assert_array_equal(a, b)
+    assert meta_l["ewma"] == meta_c["ewma"]
+
+
+def test_recovery_budget_exhaustion_surfaces_host_lost(data_root, tmp_path):
+    """max_recoveries=0: the very first HostLost must surface instead of
+    being absorbed — a bounded budget, like every retry in this codebase."""
+    from deepgo_tpu.parallel.liveness import HostLost
+
+    run_dir = str(tmp_path / "run")
+    HeartbeatWriter(os.path.join(run_dir, "heartbeats"), 1).beat(0)
+    with pytest.raises(HostLost):
+        run_elastic(run_dir, 15, overrides=tiny_overrides(data_root),
+                    ecfg=ElasticConfig(process_id=0, expected_hosts=2,
+                                       heartbeat_interval_s=0.05,
+                                       miss_budget=4, max_recoveries=0))
+
+
+# ---- the dist_collective chaos site ----
+
+
+def test_dist_collective_site_threaded_only_when_elastic(data_root, tmp_path):
+    faults.install("dist_collective:fail@1")
+    cfg = ExperimentConfig(run_dir=str(tmp_path / "a"), elastic=True,
+                           **tiny_overrides(data_root))
+    exp = Experiment(cfg)
+    with pytest.raises(faults.InjectedFailure):
+        exp.run(2)
+
+    faults.install("dist_collective:fail@1")
+    cfg2 = ExperimentConfig(run_dir=str(tmp_path / "b"),
+                            **tiny_overrides(data_root))
+    exp2 = Experiment(cfg2)
+    exp2.run(2)  # non-elastic runs never consult the site
+    assert exp2.step == 2
+
+
+# ---- the real thing: two processes, one SIGKILL ----
+
+
+def run_host(rundir, data_root, *, host=0, hosts=1, iters=800,
+             faults_env=None, budget=(0.5, 8)):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DEEPGO_FAULTS", None)
+    if faults_env:
+        env["DEEPGO_FAULTS"] = faults_env
+    sets = [
+        "name=elastic-chaos", "num_layers=2", "channels=8", "batch_size=8",
+        "rate=0.05", "validation_size=16", "validation_interval=100",
+        "print_interval=5", f"data_root={data_root}",
+        "train_split=validation", "validation_split=test",
+        "loader_threads=0", "data_parallel=2", "keep_checkpoints=0",
+    ]
+    interval, miss = budget
+    cmd = [sys.executable, "-m", "deepgo_tpu.cli", "train",
+           "--iters", str(iters), "--elastic", "--auto-resume", rundir,
+           "--process-id", str(host), "--expected-hosts", str(hosts),
+           "--heartbeat-interval", str(interval), "--miss-budget", str(miss),
+           "--init-deadline", "120", "--step-deadline", "300",
+           "--set", *sets]
+    return subprocess.Popen(cmd, cwd=REPO_ROOT, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+
+@pytest.mark.slow
+def test_two_host_sigkill_chaos_recovers_bit_exact(data_root, tmp_path):
+    """Acceptance: two elastic hosts over one shared run dir; host 1 is
+    SIGKILLed mid-training by the ``kill`` fault site. The survivor must
+    detect the loss within the heartbeat miss budget (modulo its window
+    cadence), converge on the latest valid checkpoint, re-mesh, resume,
+    and land on a final state bit-identical to an uninterrupted
+    single-host run over the same step indices."""
+    shared = str(tmp_path / "fleet")
+    iters, interval, miss = 800, 0.5, 8
+    budget_s = interval * miss
+
+    procs = [
+        run_host(shared, data_root, host=0, hosts=2, iters=iters,
+                 budget=(interval, miss)),
+        # the victim: last beat at its step-5 window, SIGKILL at step 7
+        run_host(shared, data_root, host=1, hosts=2, iters=iters,
+                 faults_env="kill:step@7", budget=(interval, miss)),
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    (rc0, out0, err0), (rc1, out1, err1) = outs
+    assert rc1 == -9, (rc1, err1[-800:])        # the kill site is honest
+    assert rc0 == 0, (rc0, err0[-2000:])        # the survivor finishes
+
+    recs = [json.loads(l.split(" ", 1)[1]) for l in out0.splitlines()
+            if l.startswith("ELASTIC_RECOVERY ")]
+    done = [json.loads(l.split(" ", 1)[1]) for l in out0.splitlines()
+            if l.startswith("ELASTIC_DONE ")]
+    assert done and done[-1]["final_step"] == iters
+    assert done[-1]["recoveries"] >= 1
+    assert recs, "survivor never reported a recovery"
+    rec = recs[0]
+    assert rec["process_id"] == 1
+    # detected within the miss budget, modulo one liveness-check window
+    # (checks ride the print-window cadence; generous slack for CI load)
+    assert rec["detect_latency_s"] > budget_s
+    assert rec["detect_latency_s"] < budget_s + 20.0
+    assert rec["steps_lost"] >= 0
+    assert rec["resumed_step"] <= rec["step_at_detection"]
+    assert rec["survivors"] == [0]
+
+    # uninterrupted single-host reference over the same step indices
+    ref_dir = str(tmp_path / "ref")
+    ref = run_host(ref_dir, data_root, host=0, hosts=1, iters=iters,
+                   budget=(interval, miss))
+    ref_out, ref_err = ref.communicate(timeout=300)
+    assert ref.returncode == 0, ref_err[-2000:]
+
+    meta_s, p_s, o_s = ckpt.load_checkpoint(
+        os.path.join(shared, ckpt.checkpoint_name(iters)))
+    meta_r, p_r, o_r = ckpt.load_checkpoint(
+        os.path.join(ref_dir, ckpt.checkpoint_name(iters)))
+    for a, b in zip(p_s + o_s, p_r + o_r):
+        np.testing.assert_array_equal(a, b)
+    assert meta_s["ewma"] == meta_r["ewma"]
+    keys = ("step", "cost", "accuracy", "n")
+    assert ([{k: v[k] for k in keys} for v in meta_s["validation_history"]]
+            == [{k: v[k] for k in keys} for v in meta_r["validation_history"]])

@@ -1,0 +1,223 @@
+"""Heartbeat liveness (parallel/liveness.py): writer, ledger, miss budget,
+stragglers, and the typed distributed error family. Every transition is
+driven by a fake clock — no sleeps."""
+
+import json
+import os
+
+import pytest
+
+from deepgo_tpu.parallel import liveness
+from deepgo_tpu.parallel.liveness import (
+    ConfigError,
+    CoordinatorUnreachable,
+    DistributedError,
+    HeartbeatLedger,
+    HeartbeatWriter,
+    HostLost,
+    StragglerDetected,
+)
+from deepgo_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_error_family_is_typed_and_routable():
+    for cls in (ConfigError, HostLost, StragglerDetected,
+                CoordinatorUnreachable):
+        assert issubclass(cls, DistributedError)
+        assert issubclass(cls, RuntimeError)
+    # a coordinator failure is ALSO an OSError, so generic transient-I/O
+    # retry policies (retry_with_backoff's default retry_on) retry it
+    assert issubclass(CoordinatorUnreachable, OSError)
+    # a config error is ALSO a ValueError (it is a bad argument)
+    assert issubclass(ConfigError, ValueError)
+
+
+def test_writer_writes_atomic_json_record(tmp_path):
+    clock = FakeClock()
+    w = HeartbeatWriter(str(tmp_path), 3, clock=clock)
+    assert w.beat(40, step_latency_s=0.25)
+    rec = json.loads(open(w.path).read())
+    assert rec == {"process_id": 3, "beat": 0, "step": 40,
+                   "time": 1000.0, "step_latency_s": 0.25}
+    clock.advance(2.0)
+    assert w.beat(45)
+    rec = json.loads(open(w.path).read())
+    assert rec["beat"] == 1 and rec["time"] == 1002.0
+    assert "step_latency_s" not in rec
+    assert w.beats == 2
+    # no stray temp files: the write is atomic
+    assert sorted(os.listdir(tmp_path)) == [liveness.heartbeat_name(3)]
+
+
+def test_writer_absorbs_transient_write_faults(tmp_path):
+    faults.install("heartbeat:transient@2")
+    w = HeartbeatWriter(str(tmp_path), 0, clock=FakeClock())
+    assert w.beat(1)  # two transients absorbed by the bounded retry
+    assert w.misses == 0 and w.beats == 1
+
+
+def test_writer_survives_hard_write_fault_loudly(tmp_path, capsys):
+    faults.install("heartbeat:fail@1")
+    w = HeartbeatWriter(str(tmp_path), 0, clock=FakeClock())
+    assert not w.beat(1)  # hard fault: absorbed, logged, counted
+    assert w.misses == 1 and w.beats == 0
+    assert "heartbeat" in capsys.readouterr().err
+    assert w.beat(2)  # next beat lands fine
+    assert json.loads(open(w.path).read())["step"] == 2
+
+
+def test_liveness_within_budget_is_quiet(tmp_path):
+    clock = FakeClock()
+    w = HeartbeatWriter(str(tmp_path), 1, clock=clock)
+    ledger = HeartbeatLedger(str(tmp_path), interval_s=1.0, miss_budget=3,
+                             clock=clock)
+    w.beat(10)
+    clock.advance(3.0)  # silence == budget exactly: still alive
+    ledger.check_liveness({1})
+
+
+def test_liveness_past_budget_raises_typed_host_lost(tmp_path):
+    clock = FakeClock()
+    w = HeartbeatWriter(str(tmp_path), 1, clock=clock)
+    ledger = HeartbeatLedger(str(tmp_path), interval_s=1.0, miss_budget=3,
+                             clock=clock)
+    w.beat(10)
+    clock.advance(3.01)
+    with pytest.raises(HostLost) as err:
+        ledger.check_liveness({1})
+    e = err.value
+    assert e.process_id == 1
+    assert e.last_seen == 1000.0
+    assert e.silent_for_s == pytest.approx(3.01)
+    assert e.budget_s == 3.0
+    assert e.last_step == 10
+    assert "host 1 lost" in str(e)
+
+
+def test_never_seen_host_lost_after_grace_from_first_poll(tmp_path):
+    clock = FakeClock()
+    ledger = HeartbeatLedger(str(tmp_path), interval_s=0.5, miss_budget=4,
+                             clock=clock)
+    ledger.poll()  # starts the grace window
+    clock.advance(1.9)
+    ledger.check_liveness({7})  # within budget: bootstrap grace
+    clock.advance(0.2)
+    with pytest.raises(HostLost) as err:
+        ledger.check_liveness({7})
+    assert err.value.process_id == 7
+    assert err.value.last_step is None  # never beat at all
+
+
+def test_longest_silent_host_reported_first(tmp_path):
+    clock = FakeClock()
+    a = HeartbeatWriter(str(tmp_path), 1, clock=clock)
+    a.beat(5)
+    clock.advance(2.0)
+    b = HeartbeatWriter(str(tmp_path), 2, clock=clock)
+    b.beat(5)
+    clock.advance(10.0)
+    ledger = HeartbeatLedger(str(tmp_path), interval_s=1.0, miss_budget=3,
+                             clock=clock)
+    with pytest.raises(HostLost) as err:
+        ledger.check_liveness({1, 2})
+    assert err.value.process_id == 1  # silent longest
+
+
+def test_corrupt_heartbeat_file_reads_as_silence_not_crash(tmp_path):
+    clock = FakeClock()
+    w = HeartbeatWriter(str(tmp_path), 0, clock=clock)
+    w.beat(1)
+    with open(os.path.join(str(tmp_path), liveness.heartbeat_name(1)),
+              "w") as f:
+        f.write('{"process_id": 1, "time": ')  # torn json
+    logged = []
+    ledger = HeartbeatLedger(str(tmp_path), interval_s=1.0, miss_budget=2,
+                             clock=clock, log=logged.append)
+    assert set(ledger.read()) == {0}
+    assert any("skipping" in m for m in logged)
+    clock.advance(2.01)  # the corrupt host is silent -> detectable
+    with pytest.raises(HostLost):
+        ledger.check_liveness({1})
+
+
+def test_straggler_detection_from_rolling_latencies(tmp_path):
+    clock = FakeClock()
+    fast = HeartbeatWriter(str(tmp_path), 0, clock=clock)
+    slow = HeartbeatWriter(str(tmp_path), 1, clock=clock)
+    ledger = HeartbeatLedger(str(tmp_path), interval_s=1.0, miss_budget=3,
+                             clock=clock)
+    for step in range(4):
+        fast.beat(step, step_latency_s=0.01)
+        slow.beat(step, step_latency_s=0.10)
+        ledger.poll()
+        clock.advance(0.5)
+    report = ledger.straggler_report(factor=3.0, min_beats=3)
+    assert [s.process_id for s in report] == [1]
+    s = report[0]
+    assert s.latency_s == pytest.approx(0.10)
+    assert "straggling" in str(s)
+    # tightest factor that still clears the slow host's own median
+    assert ledger.straggler_report(factor=50.0) == []
+
+
+def test_straggler_needs_min_beats_and_a_peer(tmp_path):
+    clock = FakeClock()
+    lone = HeartbeatWriter(str(tmp_path), 0, clock=clock)
+    ledger = HeartbeatLedger(str(tmp_path), clock=clock)
+    for step in range(5):
+        lone.beat(step, step_latency_s=0.5)
+        ledger.poll()
+    assert ledger.straggler_report() == []  # no fleet to compare against
+
+
+def test_poll_keys_latency_samples_on_beat_sequence(tmp_path):
+    """Re-reading the same unchanged beat must not double-count its
+    latency sample into the rolling window."""
+    clock = FakeClock()
+    w = HeartbeatWriter(str(tmp_path), 0, clock=clock)
+    w.beat(1, step_latency_s=0.2)
+    ledger = HeartbeatLedger(str(tmp_path), clock=clock)
+    for _ in range(5):
+        ledger.poll()
+    assert len(ledger._latencies[0]) == 1
+
+
+def test_ledger_snapshot_reports_silence_and_latency(tmp_path):
+    clock = FakeClock()
+    w = HeartbeatWriter(str(tmp_path), 2, clock=clock)
+    w.beat(30, step_latency_s=0.05)
+    ledger = HeartbeatLedger(str(tmp_path), interval_s=1.0, miss_budget=5,
+                             clock=clock)
+    ledger.poll()
+    clock.advance(1.5)
+    snap = ledger.snapshot()
+    assert snap["budget_s"] == 5.0
+    assert snap["hosts"][2]["step"] == 30
+    assert snap["hosts"][2]["silent_for_s"] == pytest.approx(1.5)
+    assert snap["hosts"][2]["median_latency_s"] == pytest.approx(0.05)
+
+
+def test_ledger_config_validation_is_typed():
+    with pytest.raises(ConfigError):
+        HeartbeatLedger("x", interval_s=0.0)
+    with pytest.raises(ConfigError):
+        HeartbeatLedger("x", miss_budget=0)

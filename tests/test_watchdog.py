@@ -1,0 +1,48 @@
+"""External-process watchdog (utils/watchdog.py): fuse arithmetic.
+
+The kill path itself is pinned by test_graft_entry.py (arm + C-level wedge
+-> SIGKILL) and the disarm path by its survive-past-fuse case; what lives
+here is the satellite boundary fix: the child's 1-second poll count must
+round the budget UP, because an early kill murders a healthy process while
+a late one only delays a diagnosis.
+"""
+
+import subprocess
+import sys
+import time
+
+from deepgo_tpu.utils import watchdog
+
+
+def test_poll_count_rounds_fractional_budgets_up():
+    # the regression: int(1.5) == 1 made a 1.5s fuse fire at ~1s
+    assert watchdog._poll_count(1.5) == 2
+    assert watchdog._poll_count(0.1) == 1
+    assert watchdog._poll_count(2.0) == 2
+    assert watchdog._poll_count(2.000001) == 3
+    # degenerate budgets poll at least once instead of insta-killing
+    assert watchdog._poll_count(0.0) == 1
+    assert watchdog._poll_count(-3.0) == 1
+
+
+def test_fractional_fuse_does_not_fire_early():
+    """A process armed with timeout_s=1.5 must still be alive at ~1.2s —
+    before the fix the truncated fuse had already SIGKILLed it."""
+    code = (
+        "import sys, time\n"
+        "sys.path.insert(0, sys.argv[1])\n"
+        "from deepgo_tpu.utils import watchdog\n"
+        "wd = watchdog.arm('boundary-test', timeout_s=1.5)\n"
+        "time.sleep(1.2)\n"
+        "wd.disarm()\n"
+        "print('SURVIVED')\n"
+    )
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.time()
+    r = subprocess.run([sys.executable, "-c", code, repo],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, (r.returncode, r.stderr[-500:])
+    assert "SURVIVED" in r.stdout
+    assert time.time() - t0 >= 1.2
