@@ -1,7 +1,7 @@
 # Developer entry points. `scripts/setup.sh` chains native + data + test.
 
 .PHONY: native data test test-full verify verify-faults verify-serving \
-    verify-resilience verify-distributed bench smoke clean
+    verify-resilience verify-distributed verify-obs bench smoke clean
 
 native:
 	$(MAKE) -C native
@@ -32,7 +32,10 @@ verify-distributed:  # multi-host elastic: liveness, deadlines, subprocess chaos
 	    tests/test_deadlines.py tests/test_elastic.py \
 	    tests/test_distributed.py tests/test_watchdog.py -q
 
-verify: verify-faults verify-serving verify-resilience verify-distributed  # the full failure-model suite
+verify-obs:  # observability: registry concurrency, exporter round-trip, spans, rotation
+	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
+
+verify: verify-faults verify-serving verify-resilience verify-distributed verify-obs  # the full failure-model suite
 
 bench:
 	python bench.py

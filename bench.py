@@ -619,7 +619,21 @@ def _bench_distributed(faults_spec: str | None = None) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def _bench_serving(on_tpu: bool, faults_spec: str | None = None) -> dict:
+def _attach_obs(result: dict, exporter) -> None:
+    """--obs-port contract: the final registry snapshot rides in the
+    BENCH json, so a chaos run's counters (restarts, sheds, poisons,
+    dispatch histograms) land in the artifact even when nobody scraped
+    the live endpoint in time."""
+    if exporter is None:
+        return
+    from deepgo_tpu.obs import get_registry
+
+    result["obs_registry"] = get_registry().snapshot()["metrics"]
+    exporter.close()
+
+
+def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
+                   exporter=None) -> dict:
     """Micro-batching engine throughput under concurrent submitters.
 
     Unlike --mode inference (one giant pre-staged batch through a scan —
@@ -663,6 +677,12 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None) -> dict:
             name="bench")
     else:
         engine = InferenceEngine(forward, params, ecfg, name="bench")
+    if exporter is not None and faults_spec:
+        # the chaos bench is scrapeable live: /healthz serves the
+        # supervisor's verdict while faults fire
+        from deepgo_tpu.obs import health_from_engine
+
+        exporter.add_health("serving", health_from_engine(engine))
     engine.warmup()
 
     import threading
@@ -754,12 +774,23 @@ def main() -> None:
                          "host). Serving reports goodput + restart/shed/"
                          "poison counters; distributed reports recovery "
                          "latency + steps lost")
+    ap.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                    help="serve live /metrics + /healthz while the bench "
+                         "runs (0 = ephemeral port) and attach the final "
+                         "registry snapshot to the BENCH json "
+                         "(docs/observability.md)")
     args = ap.parse_args()
     if args.faults is not None and args.mode not in ("serving", "distributed"):
         ap.error("--faults only applies to --mode serving or distributed")
     if args.faults == "__default__":
         args.faults = (DEFAULT_DIST_FAULTS if args.mode == "distributed"
                        else DEFAULT_CHAOS_FAULTS)
+
+    obs_exporter = None
+    if args.obs_port is not None:
+        from deepgo_tpu.obs import start_exporter
+
+        obs_exporter = start_exporter(args.obs_port)
 
     if args.mode == "distributed":
         # pure subprocess orchestration: the children pin JAX_PLATFORMS=cpu
@@ -770,6 +801,7 @@ def main() -> None:
         result = _bench_distributed(args.faults)
         result["device"] = "cpu (2 simulated elastic hosts)"
         watchdog.disarm()
+        _attach_obs(result, obs_exporter)
         print(json.dumps(result))
         return
 
@@ -792,7 +824,8 @@ def main() -> None:
 
     if args.mode != "inference":
         if args.mode == "serving":
-            result = _bench_serving(on_tpu, args.faults)
+            result = _bench_serving(on_tpu, args.faults,
+                                    exporter=obs_exporter)
         else:
             fn = {"train": _bench_train, "latency": _bench_latency,
                   "large": _bench_large}[args.mode]
@@ -801,6 +834,7 @@ def main() -> None:
         watchdog.disarm()
         if on_tpu and result.get("value"):
             _record_last_good(result)
+        _attach_obs(result, obs_exporter)
         print(json.dumps(result))
         return
 
@@ -849,6 +883,7 @@ def main() -> None:
     }
     if on_tpu:
         _record_last_good(result)
+    _attach_obs(result, obs_exporter)
     print(json.dumps(result))
 
 

@@ -7,6 +7,9 @@
   python -m deepgo_tpu.cli selfplay    engine-driven batched self-play
                                        (forwards to deepgo_tpu.selfplay;
                                        inference rides the serving engine)
+  python -m deepgo_tpu.cli obs         offline observability report: join a
+                                       run's metrics/trace/elastic JSONL
+                                       streams into one per-stage table
 
 Config overrides are ``--set key=value`` pairs against ExperimentConfig
 (the reference's prototype-override tables, experiments.lua:19-31, and its
@@ -79,6 +82,7 @@ def cmd_train(args) -> None:
             max_recoveries=args.max_recoveries,
             coordinator=args.coordinator,
             num_processes=args.num_processes,
+            obs_port=args.obs_port,
         )
         summary = run_elastic(args.auto_resume, args.iters,
                               overrides=parse_overrides(args.set), ecfg=ecfg)
@@ -112,9 +116,37 @@ def cmd_train(args) -> None:
         exp = Experiment(config)
         print(f"experiment {exp.id}")
         iters = args.iters
-    summary = exp.run(iters)
+    exporter = None
+    if args.obs_port is not None:
+        # live /metrics + /healthz for the single-host run
+        # (docs/observability.md); the elastic path wires its own
+        # exporter with ledger-backed health inside run_elastic
+        from .obs import start_exporter
+
+        exporter = start_exporter(args.obs_port)
+        exporter.add_health(
+            "train", lambda: {"healthy": True, "run_id": exp.id,
+                              "step": exp.step})
+    try:
+        summary = exp.run(iters)
+    finally:
+        if exporter is not None:
+            exporter.close()
     print(f"final EWMA cost {summary['final_ewma']:.4f}; "
           f"checkpoint at {exp.save()}")
+
+
+def cmd_obs(args) -> None:
+    """Offline per-stage report over one run directory (obs/report.py)."""
+    import json as _json
+
+    from .obs.report import format_report, summarize_run
+
+    summary = summarize_run(args.run_dir)
+    if args.json:
+        print(_json.dumps(summary, indent=1, default=str))
+    else:
+        print(format_report(summary))
 
 
 def cmd_eval(args) -> None:
@@ -201,6 +233,11 @@ def main(argv=None) -> None:
                         "(omit on single-host / simulated fleets)")
     p.add_argument("--num-processes", type=int, default=None,
                    help="(--elastic) jax.distributed process count")
+    p.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                   help="serve live /metrics (Prometheus text) and "
+                        "/healthz on this port for the duration of the "
+                        "run (0 = ephemeral port, printed at startup; "
+                        "docs/observability.md)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a checkpoint")
@@ -213,6 +250,16 @@ def main(argv=None) -> None:
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
     p.set_defaults(fn=cmd_localtest)
+
+    p = sub.add_parser("obs", help="offline observability report: one "
+                                   "per-stage table (loader wait, "
+                                   "dispatch latency, step time, spans, "
+                                   "recoveries) joined from a run's "
+                                   "JSONL streams")
+    p.add_argument("run_dir")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as JSON instead of the table")
+    p.set_defaults(fn=cmd_obs)
 
     # "selfplay" is forwarded before parsing (above); listed here so it
     # shows up in --help output

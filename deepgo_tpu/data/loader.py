@@ -20,10 +20,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import jax
 import numpy as np
 
+from ..obs import get_registry
 from .dataset import GoDataset
 
 
@@ -171,6 +173,19 @@ class AsyncLoader:
         self.batch_size = batch_size
         self.scheme = scheme
         self.wire = wire
+        # hot-path aggregates (docs/observability.md): how long get()
+        # callers actually block, and how full the prefetch queues run —
+        # THE feed-bound-vs-compute-bound diagnostic. Metric objects are
+        # cached here so the per-get cost is one observe() (no name
+        # lookups on the hot path).
+        reg = get_registry()
+        self._obs_wait = reg.histogram(
+            "deepgo_loader_wait_seconds",
+            "time the consumer blocked in AsyncLoader.get()")
+        self._obs_depth = reg.gauge(
+            "deepgo_loader_queue_depth",
+            "prefetch queue occupancy at the last get() (host = sampled "
+            "batches, device = device_put-dispatched batches)")
         if scheme == "winner":
             # fail fast here, not inside a worker thread: a sampler raise
             # in a worker dies silently and get() then blocks forever on
@@ -331,9 +346,17 @@ class AsyncLoader:
         off-depth requests bypass the device-prefetch queue — sampling is
         i.i.d., so ordering against prefetched batches is immaterial)."""
         stack = self.stack if stack is None else stack
+        t0 = time.monotonic()
         if self._dev_queue is not None and stack == self.stack:
-            return self._drain(self._dev_queue)
-        return self._assemble(stack)
+            batch = self._drain(self._dev_queue)
+        else:
+            batch = self._assemble(stack)
+        self._obs_wait.observe(time.monotonic() - t0)
+        if self.num_threads > 0:
+            self._obs_depth.set(self._queue.qsize(), queue="host")
+            if self._dev_queue is not None:
+                self._obs_depth.set(self._dev_queue.qsize(), queue="device")
+        return batch
 
     def __iter__(self):
         while True:
@@ -366,7 +389,6 @@ class AsyncLoader:
         if self.num_threads <= 0:
             return
         import sys
-        import time
 
         self._stop.set()
         self._drain_dev_queue()

@@ -32,6 +32,7 @@ import numpy as np
 from ..data.dataset import GoDataset
 from ..data.loader import AsyncLoader
 from ..models import policy_cnn
+from ..obs import JsonlSink, get_registry, span, trace_to
 from ..parallel import data_sharding, make_mesh, replicated_sharding
 from ..training import make_eval_step, make_train_step, make_train_step_many
 from ..training.optimizers import OPTIMIZERS
@@ -271,8 +272,21 @@ class Experiment:
         from ..utils.profiling import trace
 
         cfg = self.config
-        with trace(os.path.join(self.run_path, "trace") if cfg.profile else None):
-            return self._train(iters)
+        # one metrics stream + one span trace stream per run, both opened
+        # here so the profiler wrapper can log its output dir into the
+        # metrics (trace discoverability) and spans stream for exactly
+        # the duration of the run (obs/spans.trace_to restores the
+        # previous sink even when training raises)
+        metrics = MetricsWriter(os.path.join(self.run_path, "metrics.jsonl"))
+        trace_sink = JsonlSink(os.path.join(self.run_path, "trace.jsonl"))
+        try:
+            with trace_to(trace_sink), trace(
+                    os.path.join(self.run_path, "trace")
+                    if cfg.profile else None, metrics=metrics):
+                return self._train(iters, metrics)
+        finally:
+            trace_sink.close()
+            metrics.close()
 
     def _steps_per_call(self) -> int:
         """Resolved scan depth K: print windows must be whole numbers of
@@ -298,12 +312,29 @@ class Experiment:
                   f"print_interval={cfg.print_interval}; using {k}")
         return k
 
-    def _train(self, iters: int) -> dict:
+    def _train(self, iters: int, metrics: MetricsWriter) -> dict:
         from ..parallel import superbatch_sharding
 
         cfg = self.config
         train_set = self._dataset(cfg.train_split)
-        metrics = MetricsWriter(os.path.join(self.run_path, "metrics.jsonl"))
+        # registry aggregates over the same events the JSONL stream
+        # records: counters scrape live on /metrics between print
+        # windows, the window histogram feeds `cli obs`'s step-time row.
+        # Metric objects are bound once here — the loop pays inc/set/
+        # observe only (docs/observability.md; overhead budget <= 2%).
+        reg = get_registry()
+        obs_steps = reg.counter(
+            "deepgo_train_steps_total", "optimizer steps completed")
+        obs_samples = reg.counter(
+            "deepgo_train_samples_total", "training samples consumed")
+        obs_window = reg.histogram(
+            "deepgo_train_window_seconds",
+            "wall time of one print window")
+        obs_ewma = reg.gauge(
+            "deepgo_train_loss_ewma", "EWMA(0.95/0.05) training cost")
+        obs_sps = reg.gauge(
+            "deepgo_train_samples_per_sec",
+            "samples/sec over the last print window")
         # validation data: fixed and game-balanced (improves on the
         # reference's one random minibatch per run, train.lua:62-67)
         val_batches = self._validation_batches()
@@ -397,6 +428,8 @@ class Experiment:
                     self.step += k
                     remaining -= k
                     window_steps += k
+                    obs_steps.inc(k)
+                    obs_samples.inc(k * cfg.batch_size)
                     faults.check("kill", step=self.step)
                 else:
                     # alignment / tail remainders run through the
@@ -418,6 +451,8 @@ class Experiment:
                         self.step += 1
                         remaining -= 1
                         window_steps += 1
+                        obs_steps.inc(1)
+                        obs_samples.inc(cfg.batch_size)
                         faults.check("kill", step=self.step)
                 # losses stay on device between prints so calls dispatch
                 # asynchronously; fetching every call would serialize the
@@ -431,10 +466,15 @@ class Experiment:
                     window_steps = 0
                     metrics.write("train", step=self.step, loss=last_loss,
                                   ewma=ewma, samples_per_sec=sps)
+                    obs_window.observe(window_dt)
+                    obs_ewma.set(ewma)
+                    obs_sps.set(sps)
                     if self.step % cfg.validation_interval == 0:
-                        last_val = self.validate(val_batches)
+                        with span("validate", step=self.step):
+                            last_val = self.validate(val_batches)
                         metrics.write("validation", step=self.step, **last_val)
-                        self._save_periodic()
+                        with span("checkpoint_save", step=self.step):
+                            self._save_periodic()
                         print(f"validation at iteration {self.step}: "
                               f"cost={last_val['cost']:.4f}, "
                               f"accuracy={last_val['accuracy']:.4f}")
@@ -454,7 +494,10 @@ class Experiment:
         print(f"total samples per second {total_sps:.0f}")
         metrics.write("summary", step=self.step, ewma=ewma,
                       total_samples_per_sec=total_sps)
-        metrics.close()
+        # close-time registry state rides in the event stream so the
+        # offline report (cli obs) gets the hot-path histograms —
+        # loader wait, window times — without scraping a live process
+        metrics.write("obs_snapshot", metrics=reg.snapshot()["metrics"])
         return {
             "final_ewma": ewma,
             "samples_per_sec": total_sps,

@@ -1,0 +1,31 @@
+"""Unified observability: metrics registry, span tracing, live exporter.
+
+The reference's entire observability story was wall-clock prints and a
+Google-Forms POST (SURVEY.md §5.1/§5.5); PRs 1-4 replaced the prints with
+JSONL *event* streams but left no way to aggregate, correlate, or scrape
+them. This package closes that gap with three coordinated pieces:
+
+  * ``registry``  — process-wide labeled Counter/Gauge/Histogram
+    aggregates (thread-safe, injectable clock, snapshot-as-dict);
+  * ``spans``     — context-manager span tracing with parent/child ids
+    and exception capture, streamed to a JSONL trace file and bridged
+    onto ``jax.profiler.TraceAnnotation`` so host stages line up with
+    device traces in TensorBoard;
+  * ``exporter``  — a daemon-thread HTTP endpoint serving ``/metrics``
+    (Prometheus text) and ``/healthz`` (composed component health), plus
+    the rotating ``JsonlSink`` every event stream now writes through;
+  * ``report``    — the offline summarizer joining a run's metrics /
+    trace / elastic streams into one per-stage table (``cli obs``).
+
+Finding scaling bottlenecks is a measurement problem first (FireCaffe,
+arXiv:1511.00175; arXiv:1711.00705): every future perf claim in this
+repo starts from these numbers. See docs/observability.md.
+"""
+
+from .registry import (DEFAULT_BUCKETS_S, Counter, Gauge,  # noqa: F401
+                       Histogram, MetricsRegistry, get_registry)
+from .spans import (current_span_id, get_trace_sink,  # noqa: F401
+                    set_trace_sink, span, trace_to)
+from .exporter import (JsonlSink, ObsExporter,  # noqa: F401
+                       health_from_engine, health_from_ledger,
+                       render_prometheus, sink_files, start_exporter)

@@ -1,0 +1,302 @@
+"""Live observability endpoint + the rotating JSONL sink.
+
+Two halves, both intentionally dependency-free (stdlib ``http.server``
+and files — nothing to ``pip install`` on the container):
+
+  * ``JsonlSink`` — the one JSONL event stream everything writes through
+    (``utils.metrics.MetricsWriter`` is now a thin shim over it, so all
+    existing consumers — experiments/plot.py, the supervisor/elastic
+    event streams, the tests' ``read_jsonl`` assertions — keep working
+    unchanged). Adds what the bare appender lacked: idempotent close,
+    context-manager support, thread-safe writes, and size-based rotation
+    (``path`` -> ``path.1`` -> ``path.2`` ...) so a chaos soak cannot
+    grow one file without bound.
+  * ``ObsExporter`` — a daemon-thread HTTP server with two routes:
+    ``/metrics`` renders the registry in Prometheus text exposition
+    format (scrape it with curl or a real Prometheus), ``/healthz``
+    composes registered health callables (SupervisedEngine.health(),
+    HeartbeatLedger liveness, ...) into one JSON verdict: HTTP 200 when
+    every component is healthy, 503 the moment one is not — so a kill
+    injection flips the endpoint within the detector's own budget.
+
+Port 0 binds an ephemeral port (tests); ``exporter.port`` reports the
+real one. The server thread is a daemon and ``close()`` is idempotent —
+an exporter must never be the thing that keeps a dying process alive.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+
+
+class JsonlSink:
+    """Append-only JSONL event stream with size-based rotation."""
+
+    def __init__(self, path: str, max_bytes: int = 0, max_files: int = 5):
+        """``max_bytes=0`` disables rotation (the historical MetricsWriter
+        behavior). With rotation on, a write that would push the current
+        file past ``max_bytes`` first shifts ``path.N`` -> ``path.N+1``
+        (dropping anything past ``max_files``) and renames ``path`` to
+        ``path.1`` — newest-first numbering, logrotate-style, so readers
+        concatenate ``path.N .. path.1, path`` for the full stream."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.max_bytes = max_bytes
+        self.max_files = max_files
+        self._lock = threading.Lock()
+        self._f = open(path, "a", buffering=1)
+        self._size = self._f.tell()
+
+    def write(self, kind: str, **fields) -> None:
+        record = {"kind": kind, "time": time.time(), **fields}
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            if self._f.closed:
+                raise ValueError(f"JsonlSink({self.path}) is closed")
+            if (self.max_bytes > 0 and self._size > 0
+                    and self._size + len(line) > self.max_bytes):
+                self._rotate()
+            self._f.write(line)
+            self._size += len(line)
+
+    def _rotate(self) -> None:
+        self._f.close()
+        for n in range(self.max_files - 1, 0, -1):
+            src = f"{self.path}.{n}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{n + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "a", buffering=1)
+        self._size = 0
+
+    def close(self) -> None:
+        """Idempotent: the supervisor, the experiment, and an atexit hook
+        may all reasonably close the same sink."""
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._f.closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def sink_files(path: str, max_files: int | None = None) -> list[str]:
+    """Every existing file of a (possibly rotated) sink, oldest first —
+    what read-side consumers concatenate for the full stream. Rotations
+    are discovered on disk (``path.N``), so readers need not know the
+    writer's retention setting; ``max_files`` optionally caps how many
+    rotations to include (newest-first)."""
+    import glob as _glob
+    import re as _re
+
+    numbered = []
+    pattern = _re.compile(_re.escape(os.path.basename(path)) + r"\.(\d+)$")
+    for p in _glob.glob(path + ".*"):
+        m = pattern.match(os.path.basename(p))
+        if m:
+            numbered.append((int(m.group(1)), p))
+    numbered.sort()  # .1 is newest; oldest = highest N
+    if max_files is not None:
+        numbered = numbered[:max_files]
+    out = [p for _, p in reversed(numbered)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+# ---- Prometheus text rendering ----
+
+
+def _escape_label(value: object) -> str:
+    return (str(value).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _fmt_labels(key: tuple, extra: tuple = ()) -> str:
+    items = list(key) + list(extra)
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+def render_prometheus(registry: MetricsRegistry) -> str:
+    """The registry in Prometheus text exposition format 0.0.4."""
+    lines: list[str] = []
+    for m in registry.metrics():
+        if m.help:
+            lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, Histogram):
+            for key, (counts, total, total_sum) in sorted(
+                    m.collect_raw().items()):
+                cum = 0
+                for edge, c in zip(m.buckets, counts):
+                    cum += c
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{_fmt_labels(key, (('le', f'{edge:g}'),))} {cum}")
+                cum += counts[-1]
+                lines.append(
+                    f"{m.name}_bucket{_fmt_labels(key, (('le', '+Inf'),))} "
+                    f"{cum}")
+                lines.append(f"{m.name}_sum{_fmt_labels(key)} {total_sum:g}")
+                lines.append(f"{m.name}_count{_fmt_labels(key)} {total}")
+        elif isinstance(m, (Counter, Gauge)):
+            for key, value in sorted(m.collect().items()):
+                lines.append(f"{m.name}{_fmt_labels(key)} {value:g}")
+    return "\n".join(lines) + "\n"
+
+
+# ---- health adapters ----
+
+
+def health_from_engine(engine):
+    """Health callable over a SupervisedEngine (or anything exposing
+    ``health()`` with a ``state`` field): healthy while serving."""
+
+    def check() -> dict:
+        h = engine.health()
+        return {"healthy": h.get("state") == "serving", **h}
+
+    return check
+
+
+def health_from_ledger(ledger, expected):
+    """Health callable over a HeartbeatLedger: healthy while no expected
+    peer's silence exceeds the miss budget. ``expected`` is a callable
+    returning the peer ids to watch (the surviving set shrinks as the
+    elastic loop recovers, so it must be read live, not captured)."""
+
+    def check() -> dict:
+        from ..parallel.liveness import HostLost
+
+        try:
+            ledger.check_liveness(expected())
+        except HostLost as e:
+            return {"healthy": False, "error": str(e),
+                    "lost_process_id": e.process_id,
+                    "silent_for_s": round(e.silent_for_s, 3),
+                    "budget_s": e.budget_s}
+        snap = ledger.snapshot()
+        return {"healthy": True, "budget_s": snap["budget_s"],
+                "hosts": {str(k): v for k, v in snap["hosts"].items()}}
+
+    return check
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        exporter: ObsExporter = self.server.exporter  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = render_prometheus(exporter.registry).encode()
+            self._reply(200, body, "text/plain; version=0.0.4")
+        elif path == "/healthz":
+            payload, healthy = exporter.check_health()
+            body = (json.dumps(payload, default=str) + "\n").encode()
+            self._reply(200 if healthy else 503, body, "application/json")
+        else:
+            self._reply(404, b"not found\n", "text/plain")
+
+    def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args) -> None:
+        pass  # scrapes are high-frequency; stderr is for failures
+
+
+class ObsExporter:
+    """Daemon-thread HTTP endpoint over one registry + health callables."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: MetricsRegistry | None = None):
+        self.registry = registry or get_registry()
+        self._health_fns: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._server.exporter = self  # type: ignore[attr-defined]
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="obs-exporter",
+            daemon=True)
+        self._thread.start()
+        self._closed = False
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def add_health(self, name: str, fn) -> None:
+        """Register one component check: ``fn() -> dict`` with a boolean
+        ``healthy`` key (missing reads as healthy — components may report
+        pure detail). Re-registering a name replaces the check."""
+        with self._lock:
+            self._health_fns[name] = fn
+
+    def remove_health(self, name: str) -> None:
+        with self._lock:
+            self._health_fns.pop(name, None)
+
+    def check_health(self) -> tuple[dict, bool]:
+        """(payload, overall) — overall is the AND over components; a
+        raising check reads as unhealthy WITH the error in the payload
+        (a dying component's exception is the diagnosis, not a scrape
+        crash)."""
+        with self._lock:
+            fns = dict(self._health_fns)
+        components = {}
+        healthy = True
+        for name, fn in sorted(fns.items()):
+            try:
+                detail = dict(fn())
+            except Exception as e:  # noqa: BLE001 — reported, not raised
+                detail = {"healthy": False, "error": repr(e)}
+            ok = bool(detail.get("healthy", True))
+            detail["healthy"] = ok
+            healthy = healthy and ok
+            components[name] = detail
+        return ({"healthy": healthy, "time": time.time(),
+                 "components": components}, healthy)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def start_exporter(port: int, host: str = "127.0.0.1",
+                   registry: MetricsRegistry | None = None) -> ObsExporter:
+    """Convenience used by the CLI/bench ``--obs-port`` paths; prints the
+    bound URL once so an operator watching stdout knows where to curl."""
+    exporter = ObsExporter(port=port, host=host, registry=registry)
+    print(f"obs: serving /metrics and /healthz at {exporter.url}",
+          flush=True)
+    return exporter

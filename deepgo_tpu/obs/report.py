@@ -1,0 +1,250 @@
+"""Offline run summarizer: one per-stage table out of a run's JSONL streams.
+
+A finished (or killed) run directory holds up to three event streams —
+``metrics.jsonl`` (train/validation/serving/obs events, possibly rotated),
+``trace.jsonl`` (obs_span records), and ``elastic-NNNN.jsonl`` (per-host
+recovery events) — that describe the same timeline from different angles.
+This module joins them into the table the next perf PR argues from:
+loader wait, dispatch latency, step time, span durations, recovery
+counts, side by side with p50/p99 where a distribution exists.
+
+Distributions come from two places and the report prefers the richer one:
+the final ``obs_snapshot`` event (the registry's full histogram state at
+close — exact counts, interpolated percentiles) and, for spans, the raw
+per-occurrence records in ``trace.jsonl`` (exact percentiles, since every
+occurrence is on disk).
+
+CLI: ``python -m deepgo_tpu.cli obs RUN_DIR [--json]``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+
+from .exporter import sink_files
+
+
+def read_events(path: str) -> list[dict]:
+    """Every record of a (possibly rotated) JSONL stream, oldest first.
+    Corrupt lines are skipped — a report over a killed run must work on
+    a stream whose final line was torn mid-write."""
+    out: list[dict] = []
+    for p in sink_files(path):
+        try:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+    return out
+
+
+def _pct(values: list[float], q: float) -> float | None:
+    return float(np.percentile(values, q)) if values else None
+
+
+def _hist_row(name: str, snap: dict, unit_scale: float = 1000.0) -> dict:
+    """One table row from a registry histogram snapshot (seconds -> ms)."""
+    return {
+        "count": snap["count"],
+        "p50_ms": round(snap["p50"] * unit_scale, 3),
+        "p95_ms": round(snap["p95"] * unit_scale, 3),
+        "p99_ms": round(snap["p99"] * unit_scale, 3),
+        "mean_ms": round(snap["mean"] * unit_scale, 3),
+    }
+
+
+def summarize_run(run_dir: str) -> dict:
+    """The joined per-stage summary for one run directory."""
+    metrics = read_events(os.path.join(run_dir, "metrics.jsonl"))
+    spans = [r for r in read_events(os.path.join(run_dir, "trace.jsonl"))
+             if r.get("kind") == "obs_span"]
+    elastic: list[dict] = []
+    for p in sorted(glob.glob(os.path.join(run_dir, "elastic-*.jsonl"))):
+        elastic.extend(read_events(p))
+
+    summary: dict = {"run_dir": run_dir, "stages": {}, "events": {}}
+
+    # ---- training cadence (the train/validation/summary event grammar)
+    train = [r for r in metrics if r.get("kind") == "train"]
+    if train:
+        sps = [r["samples_per_sec"] for r in train
+               if r.get("samples_per_sec")]
+        summary["stages"]["train"] = {
+            "windows": len(train),
+            "last_step": train[-1].get("step"),
+            "last_ewma": train[-1].get("ewma"),
+            "samples_per_sec_p50": round(_pct(sps, 50) or 0.0, 1),
+            "samples_per_sec_min": round(min(sps), 1) if sps else None,
+        }
+    vals = [r for r in metrics if r.get("kind") == "validation"]
+    if vals:
+        summary["stages"]["validation"] = {
+            "count": len(vals),
+            "best_cost": round(min(r["cost"] for r in vals), 4),
+            "last_accuracy": round(vals[-1]["accuracy"], 4),
+        }
+
+    # ---- registry snapshot (the hot-path histograms: loader wait,
+    # dispatch latency, step windows) — the last one wins: it is the
+    # close-time state and subsumes the others
+    snaps = [r for r in metrics if r.get("kind") == "obs_snapshot"]
+    if snaps:
+        hists = snaps[-1].get("metrics", {})
+        stage_of = {
+            "deepgo_loader_wait_seconds": "loader_wait",
+            "deepgo_train_window_seconds": "train_window",
+            "deepgo_serving_dispatch_seconds": "serving_dispatch",
+            "deepgo_serving_request_seconds": "serving_request",
+        }
+        for metric_name, stage in stage_of.items():
+            m = hists.get(metric_name)
+            if not m or m.get("kind") != "histogram":
+                continue
+            for label, snap in m["series"].items():
+                if not snap:
+                    continue
+                key = stage if not label else f"{stage}[{label}]"
+                summary["stages"][key] = _hist_row(metric_name, snap)
+        counters = {}
+        for metric_name, m in hists.items():
+            if m.get("kind") == "counter":
+                for label, v in m["series"].items():
+                    key = metric_name if not label \
+                        else f"{metric_name}[{label}]"
+                    counters[key] = v
+        if counters:
+            summary["events"]["counters"] = counters
+
+    # ---- spans (exact per-occurrence durations from the trace stream)
+    by_name: dict[str, list[float]] = {}
+    errors: dict[str, int] = {}
+    for r in spans:
+        by_name.setdefault(r["name"], []).append(float(r["duration_s"]))
+        if r.get("status") == "error":
+            errors[r["name"]] = errors.get(r["name"], 0) + 1
+    for name, durs in sorted(by_name.items()):
+        row = {
+            "count": len(durs),
+            "p50_ms": round(_pct(durs, 50) * 1000, 3),
+            "p95_ms": round(_pct(durs, 95) * 1000, 3),
+            "p99_ms": round(_pct(durs, 99) * 1000, 3),
+            "mean_ms": round(float(np.mean(durs)) * 1000, 3),
+        }
+        if errors.get(name):
+            row["errors"] = errors[name]
+        summary["stages"][f"span:{name}"] = row
+
+    # ---- serving events (engine/supervisor JSONL grammar)
+    restarts = [r for r in metrics if r.get("kind") == "serving_restart"]
+    poisons = [r for r in metrics if r.get("kind") == "serving_poison"]
+    if restarts or poisons:
+        summary["events"]["serving"] = {
+            "restarts": len(restarts),
+            "poisoned": len(poisons),
+        }
+
+    # ---- elastic recovery (per-host streams)
+    recoveries = [r for r in elastic if r.get("kind") == "recovery"]
+    losses = [r for r in elastic if r.get("kind") == "host_lost"]
+    stragglers = [r for r in elastic if r.get("kind") == "straggler"]
+    if elastic:
+        row: dict = {
+            "hosts_seen": len({r.get("host") for r in elastic
+                               if "host" in r}),
+            "host_losses": len(losses),
+            "recoveries": len(recoveries),
+            "stragglers_flagged": len(stragglers),
+        }
+        if recoveries:
+            lat = [r["recovery_latency_s"] for r in recoveries]
+            row.update(
+                steps_lost_total=sum(r.get("steps_lost", 0)
+                                     for r in recoveries),
+                recovery_latency_s_p50=round(_pct(lat, 50), 3),
+                recovery_latency_s_max=round(max(lat), 3),
+            )
+        summary["events"]["elastic"] = row
+
+    # ---- profiler trace discoverability (utils.profiling.trace logs it)
+    traces = [r for r in metrics if r.get("kind") == "profile_trace"]
+    if traces:
+        summary["events"]["profiler_traces"] = [
+            r.get("out_dir") for r in traces]
+
+    return summary
+
+
+def format_report(summary: dict) -> str:
+    """The human rendering: one fixed-width per-stage table plus an
+    events block — terminal-greppable, no dependencies."""
+    lines = [f"run: {summary['run_dir']}"]
+    stages = summary.get("stages", {})
+    if stages:
+        cols = ["stage", "count", "p50_ms", "p95_ms", "p99_ms", "notes"]
+        rows = []
+        for name, row in stages.items():
+            notes = ", ".join(
+                f"{k}={v}" for k, v in row.items()
+                if k not in ("count", "p50_ms", "p95_ms", "p99_ms",
+                             "mean_ms") and v is not None)
+            rows.append([
+                name,
+                str(row.get("count", row.get("windows", ""))),
+                str(row.get("p50_ms", "")),
+                str(row.get("p95_ms", "")),
+                str(row.get("p99_ms", "")),
+                notes,
+            ])
+        widths = [max(len(c), *(len(r[i]) for r in rows))
+                  for i, c in enumerate(cols)]
+        lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+        lines.append("  ".join("-" * w for w in widths))
+        for r in rows:
+            lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    else:
+        lines.append("(no stage data: no metrics.jsonl / trace.jsonl "
+                     "events found)")
+    events = summary.get("events", {})
+    for section, payload in events.items():
+        lines.append("")
+        lines.append(f"{section}:")
+        if isinstance(payload, dict):
+            for k, v in payload.items():
+                lines.append(f"  {k}: {v}")
+        else:
+            for item in payload:
+                lines.append(f"  {item}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="deepgo_tpu.obs.report",
+        description="join a run's metrics/trace/elastic JSONL streams "
+                    "into one per-stage table")
+    ap.add_argument("run_dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of the table")
+    args = ap.parse_args(argv)
+    summary = summarize_run(args.run_dir)
+    if args.json:
+        print(json.dumps(summary, indent=1, default=str))
+    else:
+        print(format_report(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Context-manager span tracing: host timelines that line up with device
+traces.
+
+A span is one named region of host work — a validation pass, a checkpoint
+save, an elastic recovery — with an id, a parent id (nesting is tracked
+per-context via ``contextvars``, so concurrently running threads build
+independent trees), a status, and exception capture. Each completed span
+is
+
+  * streamed to the configured JSONL trace sink as an ``obs_span`` event
+    (the offline report joins these against the metrics stream);
+  * folded into the process registry's ``deepgo_span_seconds`` histogram,
+    keyed by span name, so /metrics serves live p50/p99 per stage;
+  * bridged onto ``jax.profiler.TraceAnnotation`` while active, so when a
+    profiler capture is running (``utils.profiling.trace``) the same
+    named region appears on the TensorBoard host timeline, aligned with
+    the device ops it caused — one vocabulary across both tools.
+
+Spans deliberately do NOT wrap the per-step hot path: a JSONL line per
+training step would be measurable overhead (the ≤2 % budget), and the
+hot paths already feed histograms directly. Spans are for the coarse
+stages whose individual occurrences matter.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+import uuid
+
+from .registry import get_registry
+
+# the active span id for the current execution context; threads started
+# fresh see None (their spans root a new tree), which is the honest
+# answer — a loader worker's I/O is not causally inside one train window
+_current: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "deepgo_obs_span", default=None)
+
+_trace_sink = None  # process-wide span sink (a JsonlSink, or None)
+
+
+def set_trace_sink(sink) -> None:
+    """Install the process-wide span sink (``None`` disables streaming).
+    The registry histogram and the profiler bridge stay active either
+    way — spans are cheap enough to always aggregate."""
+    global _trace_sink
+    _trace_sink = sink
+
+
+def get_trace_sink():
+    return _trace_sink
+
+
+@contextlib.contextmanager
+def trace_to(sink):
+    """Scoped sink installation: the experiment's train() wraps itself in
+    ``trace_to(JsonlSink(<run>/trace.jsonl))`` so spans stream to the run
+    directory for exactly the duration of the run, with the previous sink
+    (usually None) restored even when training raises."""
+    global _trace_sink
+    previous = _trace_sink
+    _trace_sink = sink
+    try:
+        yield sink
+    finally:
+        _trace_sink = previous
+
+
+def current_span_id() -> str | None:
+    return _current.get()
+
+
+def _profiler_annotation(name: str):
+    """A jax.profiler.TraceAnnotation for ``name``, or a no-op when jax
+    (or its profiler) is unavailable — spans must work in any process,
+    including ones that never touch a device."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+class Span:
+    """One open span; exposed so the body can attach fields mid-flight
+    (``span.fields["step"] = n``) that land in the JSONL record."""
+
+    __slots__ = ("name", "span_id", "parent_id", "fields", "t0_wall",
+                 "t0_mono")
+
+    def __init__(self, name: str, parent_id: str | None, fields: dict):
+        self.name = name
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.fields = fields
+        self.t0_wall = time.time()
+        self.t0_mono = time.monotonic()
+
+
+@contextlib.contextmanager
+def span(name: str, registry=None, **fields):
+    """Trace one named region: ``with span("validate", step=n): ...``.
+
+    On exit the record carries span/parent ids, wall start time, duration,
+    ``status`` ("ok" | "error"), and the exception repr when the body
+    raised — the exception itself always propagates (observability must
+    never change control flow)."""
+    parent = _current.get()
+    s = Span(name, parent, dict(fields))
+    token = _current.set(s.span_id)
+    status, error = "ok", None
+    try:
+        with _profiler_annotation(name):
+            yield s
+    except BaseException as e:
+        status, error = "error", repr(e)
+        raise
+    finally:
+        _current.reset(token)
+        duration = time.monotonic() - s.t0_mono
+        reg = registry or get_registry()
+        reg.histogram(
+            "deepgo_span_seconds",
+            "duration of named host spans (obs/spans.py)",
+        ).observe(duration, name=name, status=status)
+        sink = _trace_sink
+        if sink is not None:
+            record = {
+                "name": s.name,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "t_start": s.t0_wall,
+                "duration_s": round(duration, 9),
+                "status": status,
+                **s.fields,
+            }
+            if error is not None:
+                record["error"] = error
+            try:
+                sink.write("obs_span", **record)
+            except (OSError, ValueError):
+                # a full disk or a concurrently closed sink must not turn
+                # a healthy traced region into a crash
+                pass

@@ -47,6 +47,7 @@ from dataclasses import dataclass
 
 import jax
 
+from ..obs import get_registry, health_from_ledger, start_exporter
 from ..utils.metrics import MetricsWriter
 from .deadlines import guard_first_call, initialize_with_deadline
 from .distributed import hybrid_mesh, per_host_batch
@@ -74,6 +75,11 @@ class ElasticConfig:
     coordinator: str | None = None
     num_processes: int | None = None
     heartbeat_dir: str = ""        # default: <run_dir>/heartbeats
+    # live observability endpoint (docs/observability.md): /metrics +
+    # /healthz on this port (0 = ephemeral, None = no exporter). The
+    # health verdict composes the heartbeat ledger, so a peer kill flips
+    # /healthz to 503 within this host's own miss budget.
+    obs_port: int | None = None
 
 
 def remesh(n_model: int, survivors: set[int]):
@@ -131,6 +137,39 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
     metrics.write("elastic_start", host=ecfg.process_id,
                   expected_hosts=ecfg.expected_hosts,
                   budget_s=ledger.budget_s)
+    reg = get_registry()
+    obs_recoveries = reg.counter(
+        "deepgo_elastic_recoveries_total",
+        "host losses recovered via checkpoint convergence + re-mesh")
+    obs_steps_lost = reg.counter(
+        "deepgo_elastic_steps_lost_total",
+        "steps rolled back to the converged checkpoint across recoveries")
+    obs_alive = reg.gauge(
+        "deepgo_elastic_hosts_alive", "surviving host count")
+    obs_alive.set(len(survivors))
+    exporter = None
+    # /healthz state shared with the recovery loop: the ledger check
+    # alone is not enough — the loop shrinks ``survivors`` the instant it
+    # detects a loss, which would flip the endpoint back to healthy
+    # mid-recovery. The latch keeps it 503 from detection until the
+    # recovery record is finalized (resumed from the converged
+    # checkpoint), so the degraded window is observable from outside at
+    # any scrape cadence, not just in the sub-window race.
+    recovering = {"active": False, "lost": None}
+    if ecfg.obs_port is not None:
+        exporter = start_exporter(ecfg.obs_port)
+        ledger_check = health_from_ledger(
+            ledger, lambda: survivors - {ecfg.process_id})
+
+        def fleet_health() -> dict:
+            out = ledger_check()
+            if recovering["active"]:
+                out["healthy"] = False
+                out["recovering"] = True
+                out["lost_process_id"] = recovering["lost"]
+            return out
+
+        exporter.add_health("heartbeats", fleet_health)
 
     recoveries: list[dict] = []
     pending_loss: dict | None = None
@@ -159,9 +198,13 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
                 )
                 del rec["detected_at"]
                 recoveries.append(rec)
+                obs_recoveries.inc()
+                obs_steps_lost.inc(rec["steps_lost"])
+                obs_alive.set(len(survivors))
                 metrics.write("recovery", **rec)
                 print("ELASTIC_RECOVERY " + json.dumps(rec), flush=True)
                 pending_loss = None
+                recovering["active"] = False
             remaining = total_iters - exp.step
             if remaining <= 0:
                 log(f"elastic host {ecfg.process_id}: step {exp.step} already "
@@ -214,6 +257,8 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
                         f"({ecfg.max_recoveries}) exhausted; surfacing {e}")
                     raise
                 survivors.discard(e.process_id)
+                recovering["active"] = True
+                recovering["lost"] = e.process_id
                 if not survivors:
                     raise  # cannot happen for a live host; defensive
                 log(f"elastic host {ecfg.process_id}: {e}; converging on the "
@@ -261,4 +306,6 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
         print("ELASTIC_DONE " + json.dumps(summary), flush=True)
         return summary
     finally:
+        if exporter is not None:
+            exporter.close()
         metrics.close()

@@ -247,6 +247,16 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
     rng = np.random.default_rng(seed)
     games = [GameState() for _ in range(n_games)]
     positions = 0
+    from .obs import get_registry
+
+    reg = get_registry()
+    obs_positions = reg.counter(
+        "deepgo_selfplay_positions_total", "selfplay positions evaluated")
+    obs_rate = reg.gauge(
+        "deepgo_selfplay_positions_per_sec",
+        "positions/sec of the most recent selfplay run")
+    obs_games = reg.gauge(
+        "deepgo_selfplay_active_games", "live games in the current fleet")
     t0 = time.time()
 
     try:
@@ -263,6 +273,8 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
                        for i in range(len(active))]
             logp = np.stack([f.result() for f in futures])
             positions += len(active)
+            obs_positions.inc(len(active))
+            obs_games.set(len(active))
 
             legal = legal_mask(packed, players, active)
             logp = np.where(legal, logp, -np.inf)
@@ -273,6 +285,8 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
                 for i in range(len(active))], max_moves)
 
         dt = time.time() - t0
+        obs_rate.set(positions / dt)
+        obs_games.set(0)
         stats = {
             "games": n_games,
             "positions": positions,

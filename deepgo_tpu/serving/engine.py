@@ -31,6 +31,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..obs import get_registry
 from ..utils import faults
 from .buckets import DEFAULT_BUCKETS, BucketLadder
 
@@ -134,6 +135,33 @@ class InferenceEngine:
         self._warm_shapes = 0
         self._join_timed_out = False
         self._t_start = time.monotonic()
+        # process-registry aggregates (docs/observability.md), labeled by
+        # engine name so shared fleets stay distinguishable on /metrics;
+        # metric objects cached up front — the dispatch loop pays one
+        # observe()/inc() per event, never a registry lookup
+        reg = get_registry()
+        self._obs_dispatch = reg.histogram(
+            "deepgo_serving_dispatch_seconds",
+            "forward duration of one coalesced dispatch")
+        self._obs_request = reg.histogram(
+            "deepgo_serving_request_seconds",
+            "request latency submit-to-result")
+        self._obs_boards = reg.counter(
+            "deepgo_serving_boards_total", "boards served")
+        self._obs_dispatches = reg.counter(
+            "deepgo_serving_dispatches_total", "coalesced dispatches run")
+        self._obs_failures = reg.counter(
+            "deepgo_serving_dispatch_failures_total",
+            "dispatches failed inside the forward")
+        self._obs_timeouts = reg.counter(
+            "deepgo_serving_timeouts_total",
+            "requests expired before dispatch")
+        self._obs_occupancy = reg.gauge(
+            "deepgo_serving_occupancy",
+            "real boards / padded boards since engine start")
+        self._obs_depth = reg.gauge(
+            "deepgo_serving_queue_depth",
+            "requests waiting in the bounded queue")
         self._thread = threading.Thread(
             target=self._dispatch_loop, name=f"serving-{name}", daemon=True)
         self._thread.start()
@@ -289,6 +317,7 @@ class InferenceEngine:
                     f"InferenceEngine[{self.name}] queue"))
                 with self._lock:
                     self._timeouts += 1
+                self._obs_timeouts.inc(engine=self.name)
             elif r.future.set_running_or_notify_cancel():
                 live.append(r)
         if not live:
@@ -315,6 +344,7 @@ class InferenceEngine:
             err.__cause__ = e
             with self._lock:
                 self._dispatch_failures += 1
+            self._obs_failures.inc(engine=self.name)
             for r in live:
                 if not r.future.done():
                     r.future.set_exception(err)
@@ -329,9 +359,17 @@ class InferenceEngine:
             self._bucket_hits[bucket] = self._bucket_hits.get(bucket, 0) + 1
             self._latencies.extend(t_done - r.t_submit for r in live)
             self._dispatch_secs.append(t_done - t_fwd)
+            occupancy = self._boards / self._padded_boards
             write_metrics = (
                 self._metrics is not None
                 and self._dispatches % self.config.metrics_interval == 0)
+        self._obs_dispatch.observe(t_done - t_fwd, engine=self.name)
+        for r in live:
+            self._obs_request.observe(t_done - r.t_submit, engine=self.name)
+        self._obs_dispatches.inc(engine=self.name)
+        self._obs_boards.inc(n, engine=self.name)
+        self._obs_occupancy.set(occupancy, engine=self.name)
+        self._obs_depth.set(self._queue.qsize(), engine=self.name)
         if write_metrics:
             self._metrics.write("serving", engine=self.name, **self.stats())
 

@@ -60,6 +60,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..obs import get_registry
 from .engine import (BatchDispatchError, EngineBusy, EngineClosed,
                      EngineError, InferenceEngine)
 from .resilience import (CircuitBreaker, CircuitOpen, EngineOverloaded,
@@ -142,6 +143,26 @@ class SupervisedEngine:
         self._quarantined: list[str] = []
         self._closing = threading.Event()
         self._failed: EngineError | None = None
+        # resilience aggregates on the process registry: the counters
+        # /metrics serves live and health() already snapshots. Breaker
+        # state renders as a gauge (0 closed / 1 half-open / 2 open) so
+        # a scrape sees the transition, not just its transition count.
+        reg = get_registry()
+        self._obs_restarts = reg.counter(
+            "deepgo_serving_restarts_total", "engine rebuilds after death")
+        self._obs_shed = reg.counter(
+            "deepgo_serving_shed_total",
+            "requests shed at admission (reason=overload|breaker)")
+        self._obs_poisoned = reg.counter(
+            "deepgo_serving_poisoned_total",
+            "requests declared poison after isolated failures")
+        self._obs_replayed = reg.counter(
+            "deepgo_serving_replayed_total",
+            "in-flight requests replayed onto a fresh engine")
+        self._obs_breaker = reg.gauge(
+            "deepgo_serving_breaker_state",
+            "circuit breaker state (0 closed, 1 half-open, 2 open)")
+        self._obs_breaker.set(0, engine=name)
         self._engine = factory()
         self._thread = threading.Thread(
             target=self._supervise_loop, name=f"supervisor-{name}",
@@ -225,6 +246,7 @@ class SupervisedEngine:
             if est is not None and est > timeout_s:
                 with self._lock:
                     self._shed_overload += 1
+                self._obs_shed.inc(engine=self.name, reason="overload")
                 raise EngineOverloaded(
                     f"SupervisedEngine[{self.name}] estimated queue wait "
                     f"{est:.3f}s exceeds the request deadline {timeout_s}s "
@@ -232,6 +254,7 @@ class SupervisedEngine:
         if not self._breaker.allow():
             with self._lock:
                 self._shed_breaker += 1
+            self._obs_shed.inc(engine=self.name, reason="breaker")
             raise CircuitOpen(
                 f"SupervisedEngine[{self.name}] circuit breaker is "
                 f"{self._breaker.state}: engine failing persistently, "
@@ -344,6 +367,7 @@ class SupervisedEngine:
         with self._lock:
             self._poisoned += 1
             n = self._poisoned
+        self._obs_poisoned.inc(engine=self.name)
         path = self._quarantine(req, exc, n)
         if self._metrics is not None:
             self._metrics.write("serving_poison", engine=self.name,
@@ -411,6 +435,7 @@ class SupervisedEngine:
                 self._restarts += 1
                 self._consec_restarts += 1
                 attempt = self._consec_restarts
+            self._obs_restarts.inc(engine=self.name)
             if attempt > self.config.max_restarts:
                 self._give_up(RestartsExhausted(
                     f"SupervisedEngine[{self.name}] engine died "
@@ -457,6 +482,7 @@ class SupervisedEngine:
                 continue
             with self._lock:
                 self._replayed += 1
+            self._obs_replayed.inc(engine=self.name)
             self._submit_inner(req, block=True)
 
     def _give_up(self, err: RestartsExhausted) -> None:
@@ -470,6 +496,9 @@ class SupervisedEngine:
     # -- observability -----------------------------------------------------
 
     def _on_breaker_transition(self, old: str, new: str) -> None:
+        self._obs_breaker.set(
+            {"closed": 0, "half_open": 1, "open": 2}.get(new, -1),
+            engine=self.name)
         if self._metrics is not None:
             self._metrics.write("serving_breaker", engine=self.name,
                                 from_state=old, to_state=new)

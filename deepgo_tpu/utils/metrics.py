@@ -7,29 +7,30 @@ with local, greppable files:
     (reference logging.lua:3-25 posted hyperparams + results to a form)
   * checkpoint-based plotting -> per-run metrics JSONL consumed by
     deepgo_tpu.experiments.plot
+
+``MetricsWriter`` is now a thin shim over the obs subsystem's
+``JsonlSink`` (deepgo_tpu/obs/exporter.py): same path, same one-line
+JSON records, same ``write(kind, **fields)`` surface — every existing
+call site and consumer keeps working — plus what the bare appender
+lacked: idempotent ``close()``, context-manager support, thread-safe
+writes, and optional size-based rotation. Aggregation (counters,
+histograms, the live /metrics endpoint) lives in ``deepgo_tpu.obs``;
+this stream stays the durable event record.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import time
+
+from ..obs.exporter import JsonlSink
 
 
-class MetricsWriter:
-    """Append-only JSONL metrics stream for one run."""
+class MetricsWriter(JsonlSink):
+    """Append-only JSONL metrics stream for one run (obs JsonlSink shim)."""
 
-    def __init__(self, path: str):
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self.path = path
-        self._f = open(path, "a", buffering=1)
-
-    def write(self, kind: str, **fields) -> None:
-        record = {"kind": kind, "time": time.time(), **fields}
-        self._f.write(json.dumps(record) + "\n")
-
-    def close(self) -> None:
-        self._f.close()
+    def __init__(self, path: str, max_bytes: int = 0, max_files: int = 5):
+        super().__init__(path, max_bytes=max_bytes, max_files=max_files)
 
 
 def append_registry(registry_path: str, record: dict) -> None:

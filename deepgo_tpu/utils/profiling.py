@@ -4,6 +4,9 @@ The reference's tracing story is wall-clock prints (SURVEY.md section 5.1);
 here the same samples/sec metrics stream to JSONL, and this module adds
 real device profiling: a context manager around ``jax.profiler`` writing a
 TensorBoard-loadable trace, plus annotation helpers for named regions.
+Host-side spans (``deepgo_tpu.obs.spans``) ride the same TraceAnnotation
+mechanism, so a capture taken here shows the obs stages on the host
+timeline aligned with the device ops they caused.
 """
 
 from __future__ import annotations
@@ -15,13 +18,32 @@ import jax
 
 
 @contextlib.contextmanager
-def trace(out_dir: str | None):
-    """Capture a device/host trace into ``out_dir`` (no-op when None)."""
+def trace(out_dir: str | None, metrics=None):
+    """Capture a device/host trace into ``out_dir`` (no-op when None).
+
+    A raised ``start_trace`` (already-active profiler, unwritable dir) is
+    cleaned up before propagating — ``stop_trace`` is attempted so no
+    half-started profiler session dangles into the next capture attempt.
+    ``metrics`` (a MetricsWriter/JsonlSink) gets a ``profile_trace``
+    event naming the output dir, so traces are discoverable from the run
+    registry instead of only by crawling the filesystem."""
     if not out_dir:
         yield
         return
     os.makedirs(out_dir, exist_ok=True)
-    jax.profiler.start_trace(out_dir)
+    try:
+        jax.profiler.start_trace(out_dir)
+    except Exception:
+        # a partially-started session would poison every later capture
+        # with "profiler already active"; best-effort stop, then surface
+        # the original failure
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        raise
+    if metrics is not None:
+        metrics.write("profile_trace", out_dir=os.path.abspath(out_dir))
     try:
         yield
     finally:

@@ -1,0 +1,476 @@
+"""Observability subsystem: registry, spans, exporter, sink, report.
+
+The ISSUE-5 coverage contract: registry concurrency (N threads hammering
+one counter, exact total), histogram percentile snapshots against known
+data, exporter /metrics + /healthz round-trip on an ephemeral port, span
+nesting/exception capture, and the sink's rotation boundary — plus the
+MetricsWriter back-compat shim and the profiling trace guard.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepgo_tpu.obs import (JsonlSink, MetricsRegistry, ObsExporter,
+                            get_registry, health_from_ledger,
+                            render_prometheus, sink_files, span, trace_to)
+from deepgo_tpu.obs.report import format_report, read_events, summarize_run
+from deepgo_tpu.utils.metrics import MetricsWriter, read_jsonl
+
+
+# ---- registry ----
+
+
+class TestRegistry:
+    def test_counter_concurrent_increments_exact_total(self):
+        reg = MetricsRegistry()
+        c = reg.counter("hammer_total")
+        n_threads, per_thread = 8, 5000
+
+        def hammer():
+            for _ in range(per_thread):
+                c.inc(worker="shared")
+
+        threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value(worker="shared") == n_threads * per_thread
+
+    def test_histogram_concurrent_observes_exact_count(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("conc_seconds", buckets=(0.5, 1.0, 2.0))
+
+        def observe():
+            for i in range(2000):
+                h.observe((i % 3) * 0.7)
+
+        threads = [threading.Thread(target=observe) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.snapshot()["count"] == 12000
+
+    def test_counter_labels_are_independent_series(self):
+        reg = MetricsRegistry()
+        c = reg.counter("labeled_total")
+        c.inc(engine="a")
+        c.inc(2, engine="b")
+        c.inc()
+        assert c.value(engine="a") == 1
+        assert c.value(engine="b") == 2
+        assert c.value() == 1
+
+    def test_counter_rejects_decrease(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match="cannot decrease"):
+            reg.counter("mono_total").inc(-1)
+
+    def test_gauge_set_and_callback(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth")
+        g.set(3)
+        g.set_function(lambda: 7, queue="live")
+        assert g.value() == 3
+        assert g.value(queue="live") == 7
+        # a raising callback reads as 0.0, never a scrape crash
+        g.set_function(lambda: 1 / 0, queue="dying")
+        assert g.value(queue="dying") == 0.0
+
+    def test_histogram_percentiles_against_known_data(self):
+        # buckets at every integer: each value 1..100 owns a bucket, so
+        # interpolation is exact and percentiles are the textbook answer
+        reg = MetricsRegistry()
+        h = reg.histogram("known_seconds",
+                          buckets=tuple(float(i) for i in range(1, 101)))
+        for v in range(1, 101):
+            h.observe(float(v))
+        snap = h.snapshot()
+        assert snap["count"] == 100
+        assert snap["min"] == 1.0 and snap["max"] == 100.0
+        assert snap["p50"] == pytest.approx(50.0)
+        assert snap["p95"] == pytest.approx(95.0)
+        assert snap["p99"] == pytest.approx(99.0)
+        assert snap["mean"] == pytest.approx(50.5)
+
+    def test_histogram_single_bucket_pins_to_observed_extremes(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("coarse_seconds", buckets=(1000.0,))
+        for v in (2.0, 3.0, 4.0):
+            h.observe(v)
+        snap = h.snapshot()
+        # everything sits in one bucket; min/max clamp the interpolation
+        assert 2.0 <= snap["p50"] <= 4.0
+        assert snap["p99"] <= 4.0
+
+    def test_histogram_overflow_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("small_seconds", buckets=(1.0,))
+        h.observe(0.5)
+        h.observe(99.0)  # beyond the last edge -> +Inf bucket
+        snap = h.snapshot()
+        assert snap["count"] == 2 and snap["max"] == 99.0
+        assert snap["p99"] <= 99.0
+
+    def test_get_or_create_same_kind_returns_same_metric(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x_total") is reg.counter("x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total")
+
+    def test_invalid_metric_name_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match="invalid metric name"):
+            reg.counter("bad name!")
+
+    def test_snapshot_is_json_serializable(self):
+        reg = MetricsRegistry(clock=lambda: 123.0)
+        reg.counter("a_total").inc(engine="e")
+        reg.histogram("b_seconds").observe(0.01)
+        snap = reg.snapshot()
+        assert snap["time"] == 123.0
+        rt = json.loads(json.dumps(snap))
+        assert rt["metrics"]["a_total"]["series"]["engine=e"] == 1
+
+    def test_histogram_time_context_with_fake_clock(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("timed_seconds", buckets=(1.0, 5.0, 10.0))
+        ticks = iter([10.0, 13.0])
+        with h.time(clock=lambda: next(ticks)):
+            pass
+        snap = h.snapshot()
+        assert snap["count"] == 1
+        assert snap["min"] == 3.0
+
+
+# ---- prometheus rendering ----
+
+
+def test_render_prometheus_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests").inc(3, engine="a")
+    reg.gauge("depth").set(2)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = render_prometheus(reg)
+    assert '# TYPE req_total counter' in text
+    assert 'req_total{engine="a"} 3' in text
+    assert "depth 2" in text
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+
+
+# ---- exporter ----
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+class TestExporter:
+    def test_metrics_and_healthz_round_trip_on_ephemeral_port(self):
+        reg = MetricsRegistry()
+        reg.counter("rt_total").inc(5)
+        reg.histogram("rt_seconds", buckets=(0.1, 1.0)).observe(0.05)
+        with ObsExporter(port=0, registry=reg) as exp:
+            assert exp.port != 0
+            status, body = _get(exp.url + "/metrics")
+            assert status == 200
+            assert "rt_total 5" in body
+            assert 'rt_seconds_bucket{le="0.1"} 1' in body
+            status, body = _get(exp.url + "/healthz")
+            assert status == 200
+            assert json.loads(body)["healthy"] is True
+
+    def test_healthz_flips_to_503_when_a_component_degrades(self):
+        with ObsExporter(port=0, registry=MetricsRegistry()) as exp:
+            healthy = {"ok": True}
+            exp.add_health("engine", lambda: {"healthy": healthy["ok"]})
+            assert _get(exp.url + "/healthz")[0] == 200
+            healthy["ok"] = False
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(exp.url + "/healthz")
+            assert e.value.code == 503
+            payload = json.loads(e.value.read().decode())
+            assert payload["healthy"] is False
+            assert payload["components"]["engine"]["healthy"] is False
+
+    def test_raising_health_check_reads_unhealthy_not_crash(self):
+        with ObsExporter(port=0, registry=MetricsRegistry()) as exp:
+            exp.add_health("dying", lambda: 1 / 0)
+            payload, healthy = exp.check_health()
+            assert healthy is False
+            assert "ZeroDivisionError" in payload["components"]["dying"]["error"]
+
+    def test_unknown_path_404(self):
+        with ObsExporter(port=0, registry=MetricsRegistry()) as exp:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(exp.url + "/nope")
+            assert e.value.code == 404
+
+    def test_close_is_idempotent(self):
+        exp = ObsExporter(port=0, registry=MetricsRegistry())
+        exp.close()
+        exp.close()
+
+    def test_healthz_from_heartbeat_ledger_flips_within_budget(self):
+        # the acceptance shape: a killed peer's silence crosses
+        # interval x miss_budget and /healthz flips to 503
+        from deepgo_tpu.parallel.liveness import (HeartbeatLedger,
+                                                  HeartbeatWriter)
+
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        now = {"t": 100.0}
+        clock = lambda: now["t"]  # noqa: E731
+        writer = HeartbeatWriter(d, 1, clock=clock)
+        ledger = HeartbeatLedger(d, interval_s=1.0, miss_budget=3,
+                                 clock=clock, log=lambda m: None)
+        writer.beat(step=5)
+        with ObsExporter(port=0, registry=MetricsRegistry()) as exp:
+            exp.add_health("heartbeats", health_from_ledger(
+                ledger, lambda: {1}))
+            assert _get(exp.url + "/healthz")[0] == 200
+            now["t"] += 3.5  # one heartbeat miss-budget, and no more beats
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(exp.url + "/healthz")
+            assert e.value.code == 503
+            payload = json.loads(e.value.read().decode())
+            assert payload["components"]["heartbeats"]["lost_process_id"] == 1
+
+
+# ---- JSONL sink / MetricsWriter shim ----
+
+
+class TestSink:
+    def test_rotation_boundary_loses_no_records(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        # each record is ~45 bytes; a 120-byte cap forces rotations mid-run
+        with JsonlSink(path, max_bytes=120, max_files=20) as sink:
+            for i in range(40):
+                sink.write("ev", i=i)
+        files = sink_files(path, max_files=20)
+        assert len(files) > 1  # rotation actually happened
+        records = read_events(path)
+        assert [r["i"] for r in records] == list(range(40))
+
+    def test_rotation_retention_drops_oldest(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with JsonlSink(path, max_bytes=60, max_files=2) as sink:
+            for i in range(30):
+                sink.write("ev", i=i)
+        files = sink_files(path, max_files=10)
+        assert len(files) <= 3  # path + at most max_files rotations
+        records = read_events(path)
+        assert records[-1]["i"] == 29  # newest records always survive
+
+    def test_metrics_writer_is_backcompat_shim(self, tmp_path):
+        path = str(tmp_path / "metrics.jsonl")
+        w = MetricsWriter(path)
+        w.write("train", step=1, loss=0.5)
+        w.close()
+        w.close()  # idempotent: the satellite contract
+        rows = read_jsonl(path)
+        assert rows[0]["kind"] == "train" and rows[0]["step"] == 1
+        assert "time" in rows[0]
+
+    def test_metrics_writer_context_manager(self, tmp_path):
+        path = str(tmp_path / "metrics.jsonl")
+        with MetricsWriter(path) as w:
+            w.write("summary", ewma=1.0)
+        assert w.closed
+        assert read_jsonl(path)[0]["kind"] == "summary"
+
+    def test_write_after_close_raises(self, tmp_path):
+        w = MetricsWriter(str(tmp_path / "m.jsonl"))
+        w.close()
+        with pytest.raises(ValueError, match="closed"):
+            w.write("ev")
+
+
+# ---- spans ----
+
+
+class TestSpans:
+    def test_nesting_parent_ids_and_stream(self, tmp_path):
+        sink = JsonlSink(str(tmp_path / "trace.jsonl"))
+        with trace_to(sink):
+            with span("outer", step=3):
+                with span("inner"):
+                    pass
+        sink.close()
+        records = read_events(str(tmp_path / "trace.jsonl"))
+        inner, outer = records  # inner closes (and streams) first
+        assert inner["name"] == "inner" and outer["name"] == "outer"
+        assert inner["parent_id"] == outer["span_id"]
+        assert outer["parent_id"] is None
+        assert outer["step"] == 3
+        assert inner["status"] == outer["status"] == "ok"
+        assert inner["duration_s"] >= 0
+
+    def test_exception_capture_and_propagation(self, tmp_path):
+        sink = JsonlSink(str(tmp_path / "trace.jsonl"))
+        with trace_to(sink):
+            with pytest.raises(ValueError, match="boom"):
+                with span("failing"):
+                    raise ValueError("boom")
+        sink.close()
+        rec = read_events(str(tmp_path / "trace.jsonl"))[0]
+        assert rec["status"] == "error"
+        assert "boom" in rec["error"]
+
+    def test_spans_feed_registry_histogram(self):
+        reg = MetricsRegistry()
+        with span("staged", registry=reg):
+            pass
+        snap = reg.histogram("deepgo_span_seconds").snapshot(
+            name="staged", status="ok")
+        assert snap is not None and snap["count"] == 1
+
+    def test_trace_to_restores_previous_sink(self, tmp_path):
+        from deepgo_tpu.obs import get_trace_sink
+
+        before = get_trace_sink()
+        with trace_to(JsonlSink(str(tmp_path / "t.jsonl"))):
+            assert get_trace_sink() is not before or before is None
+        assert get_trace_sink() is before
+
+    def test_span_without_sink_is_silent(self):
+        with span("unsunk"):
+            pass  # no sink configured: must not raise
+
+
+# ---- profiling trace guard (satellite) ----
+
+
+class TestProfilingTraceGuard:
+    def test_raised_start_trace_attempts_cleanup_and_propagates(
+            self, monkeypatch, tmp_path):
+        import jax
+
+        from deepgo_tpu.utils.profiling import trace
+
+        calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace",
+            lambda d: (_ for _ in ()).throw(RuntimeError("profiler busy")))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: calls.append("stop"))
+        with pytest.raises(RuntimeError, match="profiler busy"):
+            with trace(str(tmp_path / "t")):
+                pass
+        assert calls == ["stop"]  # no dangling profiler state
+
+    def test_trace_logs_output_dir_to_metrics(self, monkeypatch, tmp_path):
+        import jax
+
+        from deepgo_tpu.utils.profiling import trace
+
+        monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+        monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+        m = MetricsWriter(str(tmp_path / "m.jsonl"))
+        with trace(str(tmp_path / "tb"), metrics=m):
+            pass
+        m.close()
+        rows = read_jsonl(str(tmp_path / "m.jsonl"))
+        assert rows[0]["kind"] == "profile_trace"
+        assert rows[0]["out_dir"].endswith("tb")
+
+    def test_trace_none_is_noop(self):
+        from deepgo_tpu.utils.profiling import trace
+
+        with trace(None):
+            pass
+
+
+# ---- offline report ----
+
+
+class TestReport:
+    def _fake_run(self, tmp_path) -> str:
+        run = tmp_path / "run"
+        run.mkdir()
+        with JsonlSink(str(run / "metrics.jsonl")) as m:
+            m.write("train", step=10, loss=0.4, ewma=0.5,
+                    samples_per_sec=100.0)
+            m.write("train", step=20, loss=0.3, ewma=0.4,
+                    samples_per_sec=120.0)
+            m.write("validation", step=20, cost=0.35, accuracy=0.42, n=64)
+            reg = MetricsRegistry()
+            reg.histogram("deepgo_loader_wait_seconds").observe(0.002)
+            reg.counter("deepgo_train_steps_total").inc(20)
+            m.write("obs_snapshot", metrics=reg.snapshot()["metrics"])
+        with JsonlSink(str(run / "trace.jsonl")) as t:
+            with trace_to(t):
+                with span("validate", step=20):
+                    pass
+        with JsonlSink(str(run / "elastic-0000.jsonl")) as e:
+            e.write("host_lost", host=0, process_id=1)
+            e.write("recovery", host=0, process_id=1, steps_lost=5,
+                    recovery_latency_s=2.5, detect_latency_s=1.0)
+        return str(run)
+
+    def test_summarize_joins_all_three_streams(self, tmp_path):
+        summary = summarize_run(self._fake_run(tmp_path))
+        assert summary["stages"]["train"]["last_step"] == 20
+        assert summary["stages"]["loader_wait"]["count"] == 1
+        assert summary["stages"]["span:validate"]["count"] == 1
+        assert summary["stages"]["validation"]["best_cost"] == 0.35
+        assert summary["events"]["elastic"]["recoveries"] == 1
+        assert summary["events"]["elastic"]["steps_lost_total"] == 5
+        assert summary["events"]["counters"][
+            "deepgo_train_steps_total"] == 20
+
+    def test_format_report_renders_table(self, tmp_path):
+        text = format_report(summarize_run(self._fake_run(tmp_path)))
+        assert "loader_wait" in text
+        assert "span:validate" in text
+        assert "elastic:" in text
+
+    def test_report_tolerates_empty_run_dir(self, tmp_path):
+        summary = summarize_run(str(tmp_path))
+        assert summary["stages"] == {}
+        assert "no stage data" in format_report(summary)
+
+    def test_report_tolerates_torn_final_line(self, tmp_path):
+        run = tmp_path / "run"
+        run.mkdir()
+        with open(run / "metrics.jsonl", "w") as f:
+            f.write(json.dumps({"kind": "train", "step": 5, "loss": 1.0,
+                                "ewma": 1.0, "samples_per_sec": 9.0}) + "\n")
+            f.write('{"kind": "train", "step": 10, "lo')  # killed mid-write
+        summary = summarize_run(str(run))
+        assert summary["stages"]["train"]["last_step"] == 5
+
+    def test_cli_obs_subcommand(self, tmp_path, capsys):
+        from deepgo_tpu.cli import main
+
+        run = self._fake_run(tmp_path)
+        main(["obs", run])
+        out = capsys.readouterr().out
+        assert "loader_wait" in out
+        main(["obs", run, "--json"])
+        out = capsys.readouterr().out
+        assert json.loads(out)["stages"]["train"]["last_step"] == 20
+
+
+# ---- default registry wiring ----
+
+
+def test_default_registry_is_process_wide():
+    assert get_registry() is get_registry()
+    # the built-in instrumentation points register here on import
+    c = get_registry().counter("deepgo_obs_selftest_total")
+    c.inc()
+    assert c.value() >= 1
