@@ -1,7 +1,8 @@
 # Developer entry points. `scripts/setup.sh` chains native + data + test.
 
 .PHONY: native data test test-full verify verify-faults verify-serving \
-    verify-resilience verify-distributed verify-obs bench smoke clean
+    verify-resilience verify-distributed verify-obs verify-slo bench \
+    bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -35,10 +36,17 @@ verify-distributed:  # multi-host elastic: liveness, deadlines, subprocess chaos
 verify-obs:  # observability: registry concurrency, exporter round-trip, spans, rotation
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
 
-verify: verify-faults verify-serving verify-resilience verify-distributed verify-obs  # the full failure-model suite
+verify-slo:  # analysis layer: SLO burn windows, sentinel gate + flight recorder, attribution coverage
+	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py tests/test_sentinel.py \
+	    tests/test_attribution.py -q
+
+verify: verify-faults verify-serving verify-resilience verify-distributed verify-obs verify-slo  # the full failure-model suite
 
 bench:
 	python bench.py
+
+bench-gate:  # regression sentinel: fail loud (exit != 0) past 10% vs BENCH_LAST_GOOD.json
+	python bench.py --gate
 
 smoke: data
 	python -m deepgo_tpu.cli localtest --iters 20

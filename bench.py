@@ -48,6 +48,19 @@ Extra modes (round-2 verdict items 2 and 5), each also one JSON line:
                    survivor's RECOVERY LATENCY plus steps lost to the
                    checkpoint rollback; without, the clean 2-host run
                    reports the elastic layer's overhead as samples/sec.
+                   Either way the JSON gains an `attribution` field — the
+                   per-host step-time decomposition (loader wait / h2d /
+                   compile / dispatch / compute / checkpoint, residual
+                   called out) joined across hosts, and the human table is
+                   printed to stderr (obs/attribution.py).
+  --gate [T]       regression sentinel (any mode): compare this run's
+                   value against the last-good record for the same metric
+                   and device (BENCH_LAST_GOOD.json) and exit nonzero on
+                   a relative regression >= T (default 0.10). The verdict
+                   rides inside the one JSON line as `gate`; cross-device
+                   comparisons skip rather than fail, and a recorded
+                   repeat spread (noise_frac) widens the threshold
+                   (obs/sentinel.py, docs/observability.md).
 """
 
 from __future__ import annotations
@@ -164,9 +177,16 @@ def _arm_watchdog(mode: str = "inference"):
     expects. A healthy TPU run finishes well under the default 900s
     (compile ~40s, measurement ~4s). Disable with BENCH_WATCHDOG=0;
     disarm() on success.
+
+    The watchdog also sends the flight-recorder grace signal (SIGUSR1,
+    one second before the kill), so a Python-level wedge leaves its
+    black-box dump (flight-NNNN.json in DEEPGO_FLIGHT_DIR, default the
+    working directory) next to the diagnostic JSON line.
     """
+    from deepgo_tpu.obs import sentinel
     from deepgo_tpu.utils import watchdog
 
+    flight = sentinel.install_signal_dump()
     if os.environ.get("BENCH_WATCHDOG") == "0":
         return watchdog.Watchdog(None)
     return watchdog.arm(
@@ -174,6 +194,7 @@ def _arm_watchdog(mode: str = "inference"):
         diagnostic_json=_diagnostic_json(
             "device unreachable: watchdog fired before any result "
             "(TPU relay claim likely wedged)", mode),
+        flight=flight,
     )
 
 
@@ -479,6 +500,36 @@ def _bench_latency(on_tpu: bool) -> dict:
     }
 
 
+def _apply_gate(result: dict, args) -> None:
+    """--gate: fold the regression sentinel's verdict into the result.
+
+    The verdict rides INSIDE the single JSON line (the driver contract
+    forbids a second line); ``_exit_gate`` turns a ``fail`` into a nonzero
+    exit after the line is printed, so drivers that parse-and-gate and
+    drivers that only check rc agree. Device-mismatched baselines (a CPU
+    smoke run vs the committed TPU capture) skip rather than fail — see
+    obs/sentinel.evaluate_gate."""
+    if getattr(args, "gate", None) is None:
+        return
+    from deepgo_tpu.obs.sentinel import GateConfig, evaluate_gate
+
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            entry = json.load(f).get(result.get("metric"))
+    except (OSError, ValueError):
+        entry = None
+    result["gate"] = evaluate_gate(
+        result, entry, GateConfig(threshold=args.gate))
+
+
+def _exit_gate(result: dict, args) -> None:
+    if getattr(args, "gate", None) is None:
+        return
+    verdict = result.get("gate", {}).get("verdict")
+    if verdict == "fail":
+        raise SystemExit(1)
+
+
 # the default chaos plan: one dispatcher kill mid-run plus a burst of
 # transient forward faults — the two failure shapes the supervisor's
 # restart and poison-isolation paths absorb
@@ -568,6 +619,17 @@ def _bench_distributed(faults_spec: str | None = None) -> dict:
                 out, err = p.communicate()
             outs.append((p.returncode, out, err))
         survivor_rc, survivor_out, survivor_err = outs[0]
+        # the attributed table: each host snapshots its registry into its
+        # elastic-NNNN.jsonl at shutdown; join them BEFORE the tmp dir
+        # dies (this is the FireCaffe-style gap attribution ROADMAP 3
+        # sweeps will extend to real host counts)
+        from deepgo_tpu.obs.attribution import (attribute_run,
+                                                format_attribution)
+
+        attribution = attribute_run(run_dir)
+        if attribution is not None:
+            print(format_attribution(attribution), file=sys.stderr,
+                  flush=True)
         done = [json.loads(l.split(" ", 1)[1])
                 for l in survivor_out.splitlines()
                 if l.startswith("ELASTIC_DONE ")]
@@ -582,6 +644,7 @@ def _bench_distributed(faults_spec: str | None = None) -> dict:
                 "vs_baseline": None,
                 "error": (f"survivor rc={survivor_rc}; "
                           + survivor_err[-400:].strip()),
+                "attribution": attribution,
             }
         summary = done[-1]
         if faults_spec:
@@ -601,6 +664,7 @@ def _bench_distributed(faults_spec: str | None = None) -> dict:
                 "final_step": summary["final_step"],
                 "survivor_samples_per_sec": round(
                     summary.get("samples_per_sec", 0.0), 1),
+                "attribution": attribution,
             }
             if not recs:
                 result["error"] = ("no recovery observed (victim outlived "
@@ -614,6 +678,7 @@ def _bench_distributed(faults_spec: str | None = None) -> dict:
             "hosts": 2,
             "recoveries": summary["recoveries"],
             "final_step": summary["final_step"],
+            "attribution": attribution,
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -677,12 +742,23 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
             name="bench")
     else:
         engine = InferenceEngine(forward, params, ecfg, name="bench")
-    if exporter is not None and faults_spec:
-        # the chaos bench is scrapeable live: /healthz serves the
-        # supervisor's verdict while faults fire
-        from deepgo_tpu.obs import health_from_engine
+    slo_tracker = None
+    if exporter is not None:
+        if faults_spec:
+            # the chaos bench is scrapeable live: /healthz serves the
+            # supervisor's verdict while faults fire
+            from deepgo_tpu.obs import health_from_engine
 
-        exporter.add_health("serving", health_from_engine(engine))
+            exporter.add_health("serving", health_from_engine(engine))
+        # SLO burn tracking over the same run: p99-style dispatch-latency
+        # objective evaluated live, degraded (but 200) on /healthz
+        from deepgo_tpu.obs.slo import HistogramLatencyObjective, SloTracker
+
+        slo_tracker = SloTracker([HistogramLatencyObjective(
+            "serving_dispatch", "deepgo_serving_dispatch_seconds",
+            threshold_s=0.25, target=0.99)])
+        slo_tracker.start(interval_s=0.5)
+        exporter.add_health("slo", slo_tracker.health)
     engine.warmup()
 
     import threading
@@ -722,6 +798,8 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     dt = time.time() - t0
     stats = engine.stats()
     health = engine.health() if faults_spec else None
+    if slo_tracker is not None:
+        slo_tracker.stop()
     engine.close()
     boards = submitters * per_thread
     goodput = outcomes["ok"] / dt
@@ -779,6 +857,14 @@ def main() -> None:
                          "runs (0 = ephemeral port) and attach the final "
                          "registry snapshot to the BENCH json "
                          "(docs/observability.md)")
+    ap.add_argument("--gate", nargs="?", const=0.10, default=None,
+                    type=float, metavar="THRESHOLD",
+                    help="regression gate: compare this run against the "
+                         "last-good record for the same metric AND device "
+                         "(BENCH_LAST_GOOD.json) and exit nonzero past "
+                         "THRESHOLD relative regression (default 0.10; "
+                         "noise-aware — see docs/observability.md). The "
+                         "verdict rides in the JSON line as `gate`")
     args = ap.parse_args()
     if args.faults is not None and args.mode not in ("serving", "distributed"):
         ap.error("--faults only applies to --mode serving or distributed")
@@ -792,6 +878,12 @@ def main() -> None:
 
         obs_exporter = start_exporter(args.obs_port)
 
+    # arm the flight recorder: a chaos fault or watchdog grace signal
+    # dumps the black box into DEEPGO_FLIGHT_DIR (default: cwd)
+    from deepgo_tpu.obs import configure_flight
+
+    configure_flight(os.environ.get("DEEPGO_FLIGHT_DIR", "."))
+
     if args.mode == "distributed":
         # pure subprocess orchestration: the children pin JAX_PLATFORMS=cpu
         # themselves (simulated hosts — see _bench_distributed), so the
@@ -802,7 +894,9 @@ def main() -> None:
         result["device"] = "cpu (2 simulated elastic hosts)"
         watchdog.disarm()
         _attach_obs(result, obs_exporter)
+        _apply_gate(result, args)
         print(json.dumps(result))
+        _exit_gate(result, args)
         return
 
     _preflight_probe(args.mode)
@@ -835,7 +929,9 @@ def main() -> None:
         if on_tpu and result.get("value"):
             _record_last_good(result)
         _attach_obs(result, obs_exporter)
+        _apply_gate(result, args)
         print(json.dumps(result))
+        _exit_gate(result, args)
         return
 
     # CPU fallback keeps the benchmark runnable anywhere; the headline
@@ -880,11 +976,17 @@ def main() -> None:
         "batch": batch,
         "device": str(device),
         "ms_per_batch": round(1000 * dt / k_batches, 2),
+        # run-to-run jitter of this very measurement: the regression
+        # gate widens its threshold by this (noise-aware gating)
+        "noise_frac": round((max(times) - min(times)) / dt, 4)
+        if len(times) > 1 else 0.0,
     }
     if on_tpu:
         _record_last_good(result)
     _attach_obs(result, obs_exporter)
+    _apply_gate(result, args)
     print(json.dumps(result))
+    _exit_gate(result, args)
 
 
 if __name__ == "__main__":

@@ -127,9 +127,23 @@ def cmd_train(args) -> None:
         exporter.add_health(
             "train", lambda: {"healthy": True, "run_id": exp.id,
                               "step": exp.step})
+    tracker = None
+    if args.slo:
+        # declarative SLOs over the live registry: burn-rate states show
+        # as gauge + slo_burn lines, and /healthz reports degraded (but
+        # stays 200) while an objective burns (docs/observability.md)
+        from .obs.slo import SloTracker, parse_slo_spec
+
+        health_fn = exporter.check_health if exporter is not None else None
+        tracker = SloTracker(parse_slo_spec(args.slo, health_fn=health_fn))
+        tracker.start(interval_s=args.slo_interval)
+        if exporter is not None:
+            exporter.add_health("slo", tracker.health)
     try:
         summary = exp.run(iters)
     finally:
+        if tracker is not None:
+            tracker.stop()
         if exporter is not None:
             exporter.close()
     print(f"final EWMA cost {summary['final_ewma']:.4f}; "
@@ -238,6 +252,18 @@ def main(argv=None) -> None:
                         "/healthz on this port for the duration of the "
                         "run (0 = ephemeral port, printed at startup; "
                         "docs/observability.md)")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="declarative SLOs evaluated live against the "
+                        "metrics registry with multi-window burn-rate "
+                        "logic, e.g. 'train_sps=1000,dispatch_ms=50@0.999"
+                        ",availability=0.999' (availability needs "
+                        "--obs-port). Burns emit slo_burn events, feed "
+                        "the deepgo_slo_burn_ratio gauge, and mark "
+                        "/healthz degraded without failing it "
+                        "(docs/observability.md; plain train path — the "
+                        "elastic loop owns its own health wiring)")
+    p.add_argument("--slo-interval", type=float, default=2.0, metavar="S",
+                   help="SLO evaluation cadence in seconds (default 2)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a checkpoint")

@@ -186,6 +186,13 @@ class AsyncLoader:
             "deepgo_loader_queue_depth",
             "prefetch queue occupancy at the last get() (host = sampled "
             "batches, device = device_put-dispatched batches)")
+        # host->device transfer time, split by whose clock paid for it:
+        # path=inline blocks the consumer (a sub-bucket of loader wait in
+        # the attribution table), path=uploader overlaps with compute
+        self._obs_h2d = reg.histogram(
+            "deepgo_h2d_seconds",
+            "host->device transfer dispatch time "
+            "(path=inline blocks the consumer, path=uploader overlaps)")
         if scheme == "winner":
             # fail fast here, not inside a worker thread: a sampler raise
             # in a worker dies silently and get() then blocks forever on
@@ -299,24 +306,29 @@ class AsyncLoader:
             except queue.Empty:
                 continue
 
-    def _assemble(self, stack: int):
+    def _assemble(self, stack: int, path: str = "inline"):
         """One device_put-dispatched (super)batch at the given depth.
 
         The default depth pulls ready-made units from the worker queue;
         an off-depth request (final partial window) samples synchronously
         — workers only ever build full-depth units, so there is nothing
-        to re-slice."""
+        to re-slice. ``path`` labels whose clock the transfer ran on
+        (inline = the consumer's; uploader = overlapped)."""
         if self.num_threads > 0 and stack == self.stack:
             batch = self._drain(self._queue)
         else:
             batch = self._produce(stack, self._sync_rng)
+        t0 = time.monotonic()
         if stack < 1:
-            if self.sharding is not None:
-                return jax.device_put(batch, self.sharding)
-            return jax.device_put(batch)
-        if self.stack_sharding is not None:
-            return jax.device_put(batch, self.stack_sharding)
-        return jax.device_put(batch)
+            sharding = self.sharding
+        else:
+            sharding = self.stack_sharding
+        if sharding is not None:
+            out = jax.device_put(batch, sharding)
+        else:
+            out = jax.device_put(batch)
+        self._obs_h2d.observe(time.monotonic() - t0, path=path)
+        return out
 
     def _upload_loop(self) -> None:
         """Uploader thread: keep the device queue full of ready-to-run
@@ -324,7 +336,7 @@ class AsyncLoader:
         relay tunnel) then costs this thread's time, not the train loop's."""
         try:
             while not self._stop.is_set():
-                batch = self._assemble(self.stack)
+                batch = self._assemble(self.stack, path="uploader")
                 while not self._stop.is_set():
                     try:
                         self._dev_queue.put(batch, timeout=0.1)

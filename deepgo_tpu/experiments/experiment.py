@@ -19,6 +19,7 @@ train.lua:62-67), and device feeding via an async double-buffered loader.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import sys
@@ -335,6 +336,33 @@ class Experiment:
         obs_sps = reg.gauge(
             "deepgo_train_samples_per_sec",
             "samples/sec over the last print window")
+        # attribution instrumentation (obs/attribution.py): together with
+        # the loader-wait histogram and the validate/checkpoint spans,
+        # these decompose the loop's wall-clock into named buckets —
+        # phase=first isolates trace+compile from steady-state dispatch
+        obs_dispatch = reg.histogram(
+            "deepgo_train_dispatch_seconds",
+            "host-blocking time inside the jitted step call "
+            "(phase=first carries trace+compile)")
+        obs_fetch = reg.histogram(
+            "deepgo_train_fetch_seconds",
+            "host time blocked fetching window losses (the device fence "
+            "— a lower bound on un-overlapped device compute)")
+        obs_hook = reg.histogram(
+            "deepgo_train_hook_seconds",
+            "window-hook time (heartbeat write + liveness checks)")
+        obs_wall = reg.counter(
+            "deepgo_train_wall_seconds_total",
+            "train-loop wall time: the attribution denominator")
+        # the crash flight recorder dumps into the run directory (kills,
+        # restarts, SLO fast burns); honor an earlier configuration (the
+        # elastic loop arms it with the shared run dir before train runs)
+        from ..obs.sentinel import configure_flight, get_flight_recorder
+
+        flight = get_flight_recorder()
+        if not flight.enabled:
+            flight = configure_flight(self.run_path)
+        dispatched_programs: set = set()  # phase=first vs phase=steady
         # validation data: fixed and game-balanced (improves on the
         # reference's one random minibatch per run, train.lua:62-67)
         val_batches = self._validation_batches()
@@ -363,14 +391,38 @@ class Experiment:
 
         def fold_pending(ewma, last_loss):
             # EWMA 0.95/0.05, matching the reference (train.lua:115). One
-            # host fetch per call, at window boundaries only.
+            # host fetch per call, at window boundaries only. The fetch
+            # blocks on every dispatched step completing — it IS the
+            # window's device fence, so its duration feeds the compute
+            # bucket of the attribution table.
+            t0 = time.monotonic()
             for losses in pending:
                 for value in np.atleast_1d(np.asarray(losses)).tolist():
                     ewma = value if ewma is None else 0.95 * ewma + 0.05 * value
                     last_loss = value
+            if pending:
+                obs_fetch.observe(time.monotonic() - t0)
             pending.clear()
             self.ewma, self.last_loss = ewma, last_loss
             return ewma, last_loss
+
+        def timed_step(step_fn, program, batch):
+            # host-blocking dispatch time, compile isolated on the first
+            # call per program. The rebind of params/opt_state happens
+            # INSIDE the timer on purpose: dropping the previous buffers
+            # is where backends that execute synchronously actually block
+            # (measured on CPU: the call returns in ~0.3 ms, the dealloc
+            # of the in-flight inputs waits ~8 ms for the step), so the
+            # dispatch bucket honestly carries un-overlapped execution
+            phase = "steady" if program in dispatched_programs else "first"
+            t0 = time.monotonic()
+            try:
+                self.params, self.opt_state, losses = step_fn(
+                    self.params, self.opt_state, batch)
+                return losses
+            finally:
+                dispatched_programs.add(program)
+                obs_dispatch.observe(time.monotonic() - t0, phase=phase)
         window_t0 = total_t0 = time.time()
         with AsyncLoader(
             train_set,
@@ -389,7 +441,11 @@ class Experiment:
             augment=cfg.augment,
             wire=self.wire,
             device_prefetch=cfg.device_prefetch,
-        ) as loader:
+        ) as loader, contextlib.ExitStack() as _wall:
+            # the attribution denominator must be credited however this
+            # scope exits — a HostLost or injected fault mid-loop still
+            # spent the wall-clock the histograms accumulated against
+            _wall.callback(lambda: obs_wall.inc(time.time() - total_t0))
             remaining = iters
             window_steps = 0
             while remaining > 0:
@@ -418,9 +474,7 @@ class Experiment:
                     batch = loader.get()
                     try:
                         faults.check("train_step")
-                        self.params, self.opt_state, losses = step_many(
-                            self.params, self.opt_state, batch
-                        )
+                        losses = timed_step(step_many, "many", batch)
                     except Exception:
                         dump_bad(batch)
                         raise
@@ -441,9 +495,8 @@ class Experiment:
                         batch = loader.get(stack=0)
                         try:
                             faults.check("train_step")
-                            self.params, self.opt_state, loss = self.train_step(
-                                self.params, self.opt_state, batch
-                            )
+                            loss = timed_step(self.train_step, "single",
+                                              batch)
                         except Exception:
                             dump_bad(batch)
                             raise
@@ -480,15 +533,21 @@ class Experiment:
                               f"accuracy={last_val['accuracy']:.4f}")
                     else:
                         print(f"training {ewma:.4f} (samples per second {sps:.0f})")
+                    # flight-recorder heartbeat: one registry snapshot per
+                    # print window keeps the ring current at no hot-path
+                    # cost (a no-op while the recorder is unarmed)
+                    flight.tick()
                     # elastic hook LAST, after the periodic checkpoint: a
                     # HostLost raised here finds the newest checkpoint
                     # already on disk for the fleet to converge on
                     if self.on_window is not None:
-                        self.on_window(self.step, window_dt, done_steps)
+                        with obs_hook.time():
+                            self.on_window(self.step, window_dt, done_steps)
 
-        # fold losses from a final partial print window into the EWMA so
-        # runs shorter than print_interval still report one
-        ewma, last_loss = fold_pending(ewma, last_loss)
+            # fold losses from a final partial print window into the EWMA
+            # so runs shorter than print_interval still report one (inside
+            # the wall-accounted scope: the fold is a device fence)
+            ewma, last_loss = fold_pending(ewma, last_loss)
         total_dt = time.time() - total_t0
         total_sps = cfg.batch_size * iters / total_dt
         print(f"total samples per second {total_sps:.0f}")

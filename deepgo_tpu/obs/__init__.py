@@ -15,7 +15,19 @@ them. This package closes that gap with three coordinated pieces:
     (Prometheus text) and ``/healthz`` (composed component health), plus
     the rotating ``JsonlSink`` every event stream now writes through;
   * ``report``    — the offline summarizer joining a run's metrics /
-    trace / elastic streams into one per-stage table (``cli obs``).
+    trace / elastic streams into one per-stage table (``cli obs``);
+
+plus the analysis-and-enforcement layer on top (ISSUE 6):
+
+  * ``attribution`` — per-step wall-clock decomposed into loader-wait /
+    h2d / compile / dispatch / compute / collective / checkpoint buckets
+    with the residual called out, joined across elastic hosts;
+  * ``slo``       — declarative objectives with multi-window error-budget
+    burn rates, ``slo_burn`` events, and a degraded-but-200 /healthz
+    component;
+  * ``sentinel``  — the noise-aware bench regression gate
+    (``bench.py --gate`` vs BENCH_LAST_GOOD.json) and the ring-buffer
+    crash flight recorder dumped on restart/HostLost/fast-burn/watchdog.
 
 Finding scaling bottlenecks is a measurement problem first (FireCaffe,
 arXiv:1511.00175; arXiv:1711.00705): every future perf claim in this
@@ -24,8 +36,17 @@ repo starts from these numbers. See docs/observability.md.
 
 from .registry import (DEFAULT_BUCKETS_S, Counter, Gauge,  # noqa: F401
                        Histogram, MetricsRegistry, get_registry)
-from .spans import (current_span_id, get_trace_sink,  # noqa: F401
-                    set_trace_sink, span, trace_to)
+from .spans import (add_span_listener, current_span_id,  # noqa: F401
+                    get_trace_sink, remove_span_listener, set_trace_sink,
+                    span, trace_to)
 from .exporter import (JsonlSink, ObsExporter,  # noqa: F401
                        health_from_engine, health_from_ledger,
                        render_prometheus, sink_files, start_exporter)
+from .sentinel import (FlightRecorder, GateConfig,  # noqa: F401
+                       configure_flight, evaluate_gate, flight_dump,
+                       get_flight_recorder, install_signal_dump)
+from .slo import (GaugeFloorObjective, HealthObjective,  # noqa: F401
+                  HistogramLatencyObjective, SLOConfig, SloTracker,
+                  parse_slo_spec)
+from .attribution import (attribute_run, attribute_snapshot,  # noqa: F401
+                          format_attribution)

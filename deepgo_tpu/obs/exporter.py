@@ -265,6 +265,7 @@ class ObsExporter:
             fns = dict(self._health_fns)
         components = {}
         healthy = True
+        degraded = False
         for name, fn in sorted(fns.items()):
             try:
                 detail = dict(fn())
@@ -273,9 +274,13 @@ class ObsExporter:
             ok = bool(detail.get("healthy", True))
             detail["healthy"] = ok
             healthy = healthy and ok
+            # degraded (an SLO burning, a breaker half-open) is an
+            # operator signal, NOT a 503: the endpoint stays 200 so load
+            # balancers keep the replica while humans see the warning
+            degraded = degraded or bool(detail.get("degraded"))
             components[name] = detail
-        return ({"healthy": healthy, "time": time.time(),
-                 "components": components}, healthy)
+        return ({"healthy": healthy, "degraded": degraded,
+                 "time": time.time(), "components": components}, healthy)
 
     def close(self) -> None:
         if self._closed:

@@ -25,6 +25,7 @@ import os
 
 import numpy as np
 
+from .attribution import attribute_run, format_attribution
 from .exporter import sink_files
 
 
@@ -125,6 +126,24 @@ def summarize_run(run_dir: str) -> dict:
                     counters[key] = v
         if counters:
             summary["events"]["counters"] = counters
+        # the serving supervisor's resilience counters, surfaced as their
+        # own section (restarts / shed / poisoned / replayed): the fleet
+        # health row an operator reads first, not buried in the generic
+        # counter dump
+        sup = {}
+        for short, metric_name in (("restarts",
+                                    "deepgo_serving_restarts_total"),
+                                   ("shed", "deepgo_serving_shed_total"),
+                                   ("poisoned",
+                                    "deepgo_serving_poisoned_total"),
+                                   ("replayed",
+                                    "deepgo_serving_replayed_total")):
+            m = hists.get(metric_name)
+            if m and m.get("kind") == "counter":
+                sup[short] = sum(m["series"].values())
+        if sup:
+            summary["events"].setdefault("serving", {}).update(
+                supervisor=sup)
 
     # ---- spans (exact per-occurrence durations from the trace stream)
     by_name: dict[str, list[float]] = {}
@@ -182,6 +201,20 @@ def summarize_run(run_dir: str) -> dict:
         summary["events"]["profiler_traces"] = [
             r.get("out_dir") for r in traces]
 
+    # ---- SLO burns (the tracker's transition events, when streamed)
+    burns = [r for r in metrics if r.get("kind") == "slo_burn"]
+    if burns:
+        summary["events"]["slo_burns"] = [
+            {k: r.get(k) for k in ("slo", "from_state", "to_state",
+                                   "burn_fast", "burn_slow")}
+            for r in burns]
+
+    # ---- step-time attribution (obs/attribution.py): the per-host
+    # wall-clock decomposition, joined across elastic hosts when present
+    att = attribute_run(run_dir)
+    if att is not None:
+        summary["attribution"] = att
+
     return summary
 
 
@@ -225,6 +258,10 @@ def format_report(summary: dict) -> str:
         else:
             for item in payload:
                 lines.append(f"  {item}")
+    att = summary.get("attribution")
+    if att:
+        lines.append("")
+        lines.append(format_attribution(att))
     return "\n".join(lines)
 
 

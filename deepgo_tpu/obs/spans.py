@@ -39,6 +39,24 @@ _current: contextvars.ContextVar[str | None] = contextvars.ContextVar(
 
 _trace_sink = None  # process-wide span sink (a JsonlSink, or None)
 
+# completed-span listeners (the flight recorder's ring buffer rides here);
+# listeners receive the same record dict the sink gets and must never be
+# able to break a traced region — exceptions are swallowed per listener
+_listeners: list = []
+
+
+def add_span_listener(fn) -> None:
+    """Register ``fn(record: dict)`` to observe every completed span."""
+    if fn not in _listeners:
+        _listeners.append(fn)
+
+
+def remove_span_listener(fn) -> None:
+    try:
+        _listeners.remove(fn)
+    except ValueError:
+        pass
+
 
 def set_trace_sink(sink) -> None:
     """Install the process-wide span sink (``None`` disables streaming).
@@ -126,7 +144,7 @@ def span(name: str, registry=None, **fields):
             "duration of named host spans (obs/spans.py)",
         ).observe(duration, name=name, status=status)
         sink = _trace_sink
-        if sink is not None:
+        if sink is not None or _listeners:
             record = {
                 "name": s.name,
                 "span_id": s.span_id,
@@ -138,9 +156,15 @@ def span(name: str, registry=None, **fields):
             }
             if error is not None:
                 record["error"] = error
-            try:
-                sink.write("obs_span", **record)
-            except (OSError, ValueError):
-                # a full disk or a concurrently closed sink must not turn
-                # a healthy traced region into a crash
-                pass
+            if sink is not None:
+                try:
+                    sink.write("obs_span", **record)
+                except (OSError, ValueError):
+                    # a full disk or a concurrently closed sink must not
+                    # turn a healthy traced region into a crash
+                    pass
+            for fn in list(_listeners):
+                try:
+                    fn(dict(record))
+                except Exception:  # noqa: BLE001 — observers never raise out
+                    pass

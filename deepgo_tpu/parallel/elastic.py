@@ -47,7 +47,8 @@ from dataclasses import dataclass
 
 import jax
 
-from ..obs import get_registry, health_from_ledger, start_exporter
+from ..obs import (configure_flight, flight_dump, get_registry,
+                   health_from_ledger, start_exporter)
 from ..utils.metrics import MetricsWriter
 from .deadlines import guard_first_call, initialize_with_deadline
 from .distributed import hybrid_mesh, per_host_batch
@@ -137,6 +138,10 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
     metrics.write("elastic_start", host=ecfg.process_id,
                   expected_hosts=ecfg.expected_hosts,
                   budget_s=ledger.budget_s)
+    # arm the crash flight recorder over the shared run dir BEFORE the
+    # training loop configures its own default: a HostLost dump then
+    # lands next to the heartbeats every survivor can read
+    configure_flight(run_dir)
     reg = get_registry()
     obs_recoveries = reg.counter(
         "deepgo_elastic_recoveries_total",
@@ -252,6 +257,12 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
                 break
             except HostLost as e:
                 detected_at = clock()
+                # black box first: the ring holds the windows that led up
+                # to the loss (spans, heartbeat latencies, loader waits)
+                flight_dump("host_lost", host=ecfg.process_id,
+                            lost_process_id=e.process_id,
+                            silent_for_s=round(e.silent_for_s, 3),
+                            step_at_detection=exp.step)
                 if len(recoveries) >= ecfg.max_recoveries:
                     log(f"elastic host {ecfg.process_id}: recovery budget "
                         f"({ecfg.max_recoveries}) exhausted; surfacing {e}")
@@ -308,4 +319,13 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
     finally:
         if exporter is not None:
             exporter.close()
+        # per-host close-time registry snapshot: the cross-host join in
+        # obs/attribution.py keys on these (the shared metrics.jsonl's
+        # snapshots interleave between hosts; this stream is ours alone)
+        try:
+            if not metrics.closed:
+                metrics.write("obs_snapshot", host=ecfg.process_id,
+                              metrics=get_registry().snapshot()["metrics"])
+        except (OSError, ValueError):
+            pass
         metrics.close()

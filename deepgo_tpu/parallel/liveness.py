@@ -38,6 +38,7 @@ import sys
 import time
 from collections import deque
 
+from ..obs import get_registry
 from ..utils.atomicio import atomic_write
 from ..utils.retry import retry_with_backoff
 from ..utils import faults
@@ -177,11 +178,19 @@ class HeartbeatLedger:
 
     def __init__(self, directory: str, interval_s: float = 1.0,
                  miss_budget: int = 3, clock=time.time,
-                 latency_window: int = 32, log=None):
+                 latency_window: int = 32, log=None, registry=None):
         if interval_s <= 0:
             raise ConfigError(f"interval_s must be > 0, got {interval_s}")
         if miss_budget < 1:
             raise ConfigError(f"miss_budget must be >= 1, got {miss_budget}")
+        # live straggler surface: each straggler_report() refreshes a
+        # per-host gauge (host median / peers' median; 1.0 = fleet-
+        # typical), so a slow host shows on /metrics without anyone
+        # calling the report — the scrape IS the call
+        self._obs_ratio = (registry or get_registry()).gauge(
+            "deepgo_straggler_ratio",
+            "per-host rolling median step latency over the peers' median "
+            "(1.0 = fleet-typical; above the straggler factor = flagged)")
         self.directory = directory
         self.interval_s = interval_s
         self.miss_budget = miss_budget
@@ -282,6 +291,8 @@ class HeartbeatLedger:
         for pid, med in sorted(medians.items()):
             peers = statistics.median(
                 [m for p, m in medians.items() if p != pid])
+            ratio = med / peers if peers > 0 else 0.0
+            self._obs_ratio.set(round(ratio, 4), host=str(pid))
             if peers > 0 and med > factor * peers:
                 report.append(StragglerDetected(pid, med, peers, factor))
         return report

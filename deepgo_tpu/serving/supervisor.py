@@ -61,6 +61,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..obs import get_registry
+from ..obs.sentinel import flight_dump
 from .engine import (BatchDispatchError, EngineBusy, EngineClosed,
                      EngineError, InferenceEngine)
 from .resilience import (CircuitBreaker, CircuitOpen, EngineOverloaded,
@@ -436,6 +437,11 @@ class SupervisedEngine:
                 self._consec_restarts += 1
                 attempt = self._consec_restarts
             self._obs_restarts.inc(engine=self.name)
+            # ship the black box with the incident: the ring buffer holds
+            # the dispatch latencies and spans that preceded the death
+            # (a no-op unless obs.sentinel.configure_flight armed it)
+            flight_dump("serving_restart", engine=self.name,
+                        attempt=attempt, total_restarts=self._restarts)
             if attempt > self.config.max_restarts:
                 self._give_up(RestartsExhausted(
                     f"SupervisedEngine[{self.name}] engine died "

@@ -52,7 +52,7 @@ class Watchdog:
 
 
 def arm(label: str, timeout_s: float = 120.0,
-        diagnostic_json: str | None = None) -> Watchdog:
+        diagnostic_json: str | None = None, flight: bool = False) -> Watchdog:
     """Arm an external watchdog that SIGKILLs this process after timeout_s.
 
     The child exits on its own when this process finishes (reparenting
@@ -62,7 +62,14 @@ def arm(label: str, timeout_s: float = 120.0,
     get a parseable record. Disabling is the caller's job (each surface
     owns its knob, e.g. BENCH_WATCHDOG / GRAFT_WATCHDOG): pass through to
     ``Watchdog(None)`` there rather than arming.
-    """
+
+    ``flight=True`` sends the parent SIGUSR1 one second before the kill —
+    the flight-recorder grace signal (obs/sentinel.install_signal_dump):
+    a parent wedged at the *Python* level (deadlocked threads, a stuck
+    queue wait) dumps its ring-buffer black box before dying. Only pass
+    it after installing the handler: SIGUSR1's default action terminates.
+    A parent wedged inside a GIL-held C call cannot run the handler — the
+    kill still proceeds, just without the dump."""
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     lines = [
         "import os, signal, sys, time",
@@ -73,6 +80,14 @@ def arm(label: str, timeout_s: float = 120.0,
         "    if os.getppid() != ppid:",
         "        sys.exit(0)",
     ]
+    if flight:
+        lines += [
+            "try:",
+            "    os.kill(ppid, signal.SIGUSR1)",
+            "    time.sleep(1)",
+            "except OSError:",
+            "    sys.exit(0)",
+        ]
     if diagnostic_json is not None:
         lines += [
             f"sys.stdout.write({diagnostic_json + chr(10)!r})",

@@ -1,0 +1,185 @@
+"""Step-time attribution (obs/attribution.py) + its report/CLI surfaces.
+
+The ISSUE-6 acceptance shape lives here: a real dryrun train must have
+>= 95 % of its measured wall-clock attributed to named buckets, with the
+residual reported (not hidden). Plus: the snapshot decomposition math on
+synthetic data, the cross-host join over elastic streams with the
+FireCaffe-style scaling block, and the `cli obs` report growing the
+attribution table and the serving supervisor counter section.
+"""
+
+import json
+import os
+
+import pytest
+
+from conftest import REPO_ROOT
+from deepgo_tpu.data.transcribe import transcribe_split
+from deepgo_tpu.experiments import Experiment, ExperimentConfig
+from deepgo_tpu.obs import JsonlSink, MetricsRegistry
+from deepgo_tpu.obs.attribution import (attribute_run, attribute_snapshot,
+                                        format_attribution)
+from deepgo_tpu.obs.report import format_report, summarize_run
+
+
+def snapshot_of(reg: MetricsRegistry) -> dict:
+    return reg.snapshot()["metrics"]
+
+
+def synthetic_registry(wall=10.0, loader=2.0, h2d_inline=0.5,
+                       compile_s=3.0, dispatch=1.0, compute=2.0,
+                       sps_samples=1000) -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("deepgo_train_wall_seconds_total").inc(wall)
+    reg.counter("deepgo_train_steps_total").inc(10)
+    reg.counter("deepgo_train_samples_total").inc(sps_samples)
+    reg.histogram("deepgo_loader_wait_seconds").observe(loader)
+    reg.histogram("deepgo_h2d_seconds").observe(h2d_inline, path="inline")
+    reg.histogram("deepgo_h2d_seconds").observe(9.9, path="uploader")
+    h = reg.histogram("deepgo_train_dispatch_seconds")
+    h.observe(compile_s, phase="first")
+    h.observe(dispatch, phase="steady")
+    reg.histogram("deepgo_train_fetch_seconds").observe(compute)
+    return reg
+
+
+class TestSnapshotMath:
+    def test_buckets_partition_and_residual_is_explicit(self):
+        att = attribute_snapshot(snapshot_of(synthetic_registry()))
+        b = att["buckets"]
+        # inline h2d is carved OUT of loader_wait: no double counting
+        assert b["loader_wait"]["seconds"] == pytest.approx(1.5)
+        assert b["h2d"]["seconds"] == pytest.approx(0.5)
+        assert b["compile"]["seconds"] == pytest.approx(3.0)
+        assert b["dispatch"]["seconds"] == pytest.approx(1.0)
+        assert b["compute"]["seconds"] == pytest.approx(2.0)
+        assert att["attributed_fraction"] == pytest.approx(0.8)
+        assert att["residual_s"] == pytest.approx(2.0)
+        assert att["residual_fraction"] == pytest.approx(0.2)
+        assert att["useful_compute_fraction"] == pytest.approx(0.2)
+        # the uploader-path h2d overlaps compute: outside the partition
+        assert att["overlapped_h2d_s"] == pytest.approx(9.9)
+        assert att["samples_per_sec"] == pytest.approx(100.0)
+
+    def test_no_wall_metric_means_no_attribution(self):
+        assert attribute_snapshot(snapshot_of(MetricsRegistry())) is None
+
+    def test_span_buckets_checkpoint_and_validate(self):
+        reg = synthetic_registry()
+        h = reg.histogram("deepgo_span_seconds")
+        h.observe(0.4, name="checkpoint_save", status="ok")
+        h.observe(0.6, name="validate", status="ok")
+        h.observe(99.0, name="unrelated_span", status="ok")
+        b = attribute_snapshot(snapshot_of(reg))["buckets"]
+        assert b["checkpoint"]["seconds"] == pytest.approx(0.4)
+        assert b["validate"]["seconds"] == pytest.approx(0.6)
+
+
+class TestCrossHostJoin:
+    def _elastic_run(self, tmp_path):
+        run = tmp_path / "run"
+        run.mkdir()
+        for host, wall in ((0, 10.0), (1, 12.0)):
+            reg = synthetic_registry(wall=wall)
+            with JsonlSink(str(run / f"elastic-{host:04d}.jsonl")) as s:
+                s.write("elastic_start", host=host)
+                s.write("obs_snapshot", host=host,
+                        metrics=snapshot_of(reg))
+        return str(run)
+
+    def test_joins_per_host_elastic_snapshots(self, tmp_path):
+        att = attribute_run(self._elastic_run(tmp_path))
+        assert att["num_hosts"] == 2
+        assert att["hosts"]["0"]["wall_s"] == pytest.approx(10.0)
+        assert att["hosts"]["1"]["wall_s"] == pytest.approx(12.0)
+        scaling = att["scaling"]
+        assert scaling["aggregate_samples_per_sec"] == pytest.approx(
+            100.0 + 1000 / 12.0, abs=0.1)
+        assert 0 < scaling["useful_compute_fraction_mean"] < 1
+        assert scaling["non_compute_fraction_mean"] == pytest.approx(
+            1 - scaling["useful_compute_fraction_mean"], abs=1e-3)
+
+    def test_falls_back_to_metrics_stream(self, tmp_path):
+        run = tmp_path / "run"
+        run.mkdir()
+        with JsonlSink(str(run / "metrics.jsonl")) as s:
+            s.write("obs_snapshot",
+                    metrics=snapshot_of(synthetic_registry()))
+        att = attribute_run(str(run))
+        assert att["num_hosts"] == 1 and "0" in att["hosts"]
+
+    def test_empty_run_dir_returns_none(self, tmp_path):
+        assert attribute_run(str(tmp_path)) is None
+
+    def test_format_renders_hosts_and_fleet_line(self, tmp_path):
+        text = format_attribution(attribute_run(self._elastic_run(tmp_path)))
+        assert "2 hosts" in text
+        assert "loader_wait" in text and "(residual)" in text
+        assert "fleet:" in text and "scaling efficiency" in text
+
+
+# ---- the acceptance bar: >= 95 % attributed on a real dryrun train ----
+
+
+@pytest.fixture(scope="module")
+def data_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("processed")
+    for split in ("validation", "test"):
+        transcribe_split(os.path.join(REPO_ROOT, "data/sgf", split),
+                         str(root / split), workers=1, verbose=False)
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def trained_run(data_root, tmp_path_factory):
+    cfg = ExperimentConfig(
+        name="attribution-dryrun", num_layers=2, channels=8, batch_size=8,
+        validation_size=16, validation_interval=10, print_interval=5,
+        data_root=data_root, train_split="validation",
+        validation_split="test", loader_threads=0, data_parallel=1,
+        run_dir=str(tmp_path_factory.mktemp("runs")))
+    exp = Experiment(cfg)
+    exp.run(30)
+    return exp.run_path
+
+
+def test_dryrun_train_attributes_95_percent_of_wall(trained_run):
+    att = attribute_run(trained_run)
+    host = att["hosts"]["0"]
+    assert host["attributed_fraction"] >= 0.95, host
+    # the residual is REPORTED, not hidden — and stays sane
+    assert abs(host["residual_fraction"]) <= 0.05
+    assert host["steps"] == 30
+    # the dominant CPU-dryrun buckets all materialized
+    for bucket in ("loader_wait", "compile", "dispatch", "validate",
+                   "checkpoint"):
+        assert bucket in host["buckets"], host["buckets"].keys()
+
+
+def test_cli_obs_report_includes_attribution_table(trained_run, capsys):
+    from deepgo_tpu.cli import main
+
+    main(["obs", trained_run])
+    out = capsys.readouterr().out
+    assert "step-time attribution" in out
+    assert "(residual)" in out
+    main(["obs", trained_run, "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["attribution"]["hosts"]["0"]["attributed_fraction"] \
+        >= 0.95
+
+
+def test_report_surfaces_supervisor_counters(tmp_path):
+    run = tmp_path / "run"
+    run.mkdir()
+    reg = MetricsRegistry()
+    reg.counter("deepgo_serving_restarts_total").inc(2, engine="e")
+    reg.counter("deepgo_serving_shed_total").inc(3, engine="e",
+                                                reason="overload")
+    reg.counter("deepgo_serving_poisoned_total").inc(1, engine="e")
+    with JsonlSink(str(run / "metrics.jsonl")) as s:
+        s.write("obs_snapshot", metrics=snapshot_of(reg))
+    summary = summarize_run(str(run))
+    sup = summary["events"]["serving"]["supervisor"]
+    assert sup == {"restarts": 2, "shed": 3, "poisoned": 1}
+    assert "supervisor" in format_report(summary)

@@ -14,9 +14,11 @@ def _run_bench(extra_env, timeout, args=()):
     # pin BENCH_WATCHDOG so an ambient =0 can't disable the tested
     # mechanism, and point BENCH_LAST_GOOD away from the committed
     # last-good table (failure tests assert the nothing-ever-measured
-    # path; the stale-fallback path has its own test)
+    # path; the stale-fallback path has its own test). DEEPGO_FLIGHT=0:
+    # the watchdog's SIGUSR1 grace would otherwise drop a flight dump
+    # into the checkout cwd (the recorder has its own tests)
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
-               BENCH_WATCHDOG="1", GRAFT_WATCHDOG="1",
+               BENCH_WATCHDOG="1", GRAFT_WATCHDOG="1", DEEPGO_FLIGHT="0",
                BENCH_LAST_GOOD="/nonexistent/bench_last_good.json")
     env.update(extra_env)
     return subprocess.run(

@@ -179,6 +179,38 @@ def test_straggler_detection_from_rolling_latencies(tmp_path):
     assert ledger.straggler_report(factor=50.0) == []
 
 
+def test_straggler_ratio_gauge_per_host(tmp_path):
+    """ISSUE-6 satellite: straggler_report is no longer report-only — each
+    call refreshes deepgo_straggler_ratio{host=N} (median over peers'
+    median), so a slow host is visible on any /metrics scrape."""
+    from deepgo_tpu.obs import MetricsRegistry
+
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    fast = HeartbeatWriter(str(tmp_path), 0, clock=clock)
+    slow = HeartbeatWriter(str(tmp_path), 1, clock=clock)
+    ledger = HeartbeatLedger(str(tmp_path), interval_s=1.0, miss_budget=3,
+                             clock=clock, registry=reg)
+    for step in range(4):
+        fast.beat(step, step_latency_s=0.01)
+        slow.beat(step, step_latency_s=0.10)
+        ledger.poll()
+        clock.advance(0.5)
+    ledger.straggler_report(factor=3.0, min_beats=3)
+    g = reg.gauge("deepgo_straggler_ratio")
+    assert g.value(host="1") == pytest.approx(10.0)   # 0.10 / 0.01
+    assert g.value(host="0") == pytest.approx(0.1)    # 0.01 / 0.10
+    # the fleet healing (the slow host speeding up) moves the gauge, not
+    # just future report calls — the gauge is live state, not an archive
+    for step in range(4, 12):
+        fast.beat(step, step_latency_s=0.01)
+        slow.beat(step, step_latency_s=0.01)
+        ledger.poll()
+        clock.advance(0.5)
+    ledger.straggler_report(factor=3.0, min_beats=3)
+    assert g.value(host="1") < 3.0
+
+
 def test_straggler_needs_min_beats_and_a_peer(tmp_path):
     clock = FakeClock()
     lone = HeartbeatWriter(str(tmp_path), 0, clock=clock)

@@ -169,6 +169,34 @@ def test_render_prometheus_counters_gauges_histograms():
     assert "lat_seconds_count 3" in text
 
 
+def test_render_prometheus_histogram_le_contract_per_labeled_series():
+    # the external-Prometheus quantile contract (ISSUE-6 satellite): every
+    # labeled series gets its own cumulative, monotone `le=` ladder whose
+    # +Inf bucket equals its _count, plus matching _sum — histogram_quantile
+    # over a scrape must be computable without this process's help
+    import re
+
+    reg = MetricsRegistry()
+    h = reg.histogram("disp_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.7, 5.0, 50.0):
+        h.observe(v, engine="a")
+    h.observe(0.01, engine="b")
+    text = render_prometheus(reg)
+    assert "# TYPE disp_seconds histogram" in text
+    for engine, expect in (("a", [1, 3, 4, 5]), ("b", [1, 1, 1, 1])):
+        pat = re.compile(
+            rf'disp_seconds_bucket\{{engine="{engine}",le="([^"]+)"\}} (\d+)')
+        ladder = [(le, int(c)) for le, c in pat.findall(text)]
+        assert [le for le, _ in ladder] == ["0.1", "1", "10", "+Inf"]
+        counts = [c for _, c in ladder]
+        assert counts == expect                       # cumulative...
+        assert counts == sorted(counts)               # ...and monotone
+        assert f'disp_seconds_count{{engine="{engine}"}} {expect[-1]}' \
+            in text
+    assert 'disp_seconds_sum{engine="a"} 56.25' in text
+    assert 'disp_seconds_sum{engine="b"} 0.01' in text
+
+
 # ---- exporter ----
 
 

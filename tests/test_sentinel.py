@@ -1,0 +1,283 @@
+"""Regression sentinel + crash flight recorder (obs/sentinel.py).
+
+Gate: pass at noise-level drift, warn in the band, fail at a >= 10 %
+regression, direction-aware for latency metrics, skip (never fail) on
+missing/cross-device baselines — and the bench integration folds the
+verdict into the one JSON line with a nonzero exit on fail.
+
+Flight recorder: ring-buffer round-trip (snapshots + spans survive into
+an atomically written flight-NNNN.json), time-based eviction, sequential
+numbering, a DEEPGO_FAULTS-injected supervisor restart dumping the spans
+that preceded the fault (the ISSUE-6 acceptance shape), and the external
+watchdog's SIGUSR1 grace signal producing a dump from a Python-level
+wedge before the SIGKILL lands.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+from deepgo_tpu.obs import MetricsRegistry, span
+from deepgo_tpu.obs.sentinel import (FlightRecorder, GateConfig,
+                                     evaluate_gate)
+
+
+# ---- the gate ----
+
+
+def fresh(value, metric="boards_per_sec", device="X", **kw):
+    return {"metric": metric, "value": value, "device": device, **kw}
+
+
+def base(value, device="X", **kw):
+    return {"value": value, "device": device, **kw}
+
+
+class TestGate:
+    def test_pass_at_noise_level_drift(self):
+        v = evaluate_gate(fresh(98.0), base(100.0))
+        assert v["verdict"] == "pass"
+
+    def test_warn_band_between_noise_and_gate(self):
+        v = evaluate_gate(fresh(93.0), base(100.0))
+        assert v["verdict"] == "warn"
+
+    def test_fail_at_ten_percent_regression(self):
+        v = evaluate_gate(fresh(90.0), base(100.0))
+        assert v["verdict"] == "fail"
+        assert v["regression"] == pytest.approx(0.10)
+
+    def test_improvement_passes(self):
+        v = evaluate_gate(fresh(130.0), base(100.0))
+        assert v["verdict"] == "pass"
+        assert v["regression"] < 0
+
+    def test_lower_is_better_direction(self):
+        lat = "policy_inference_latency_ms"
+        assert evaluate_gate(fresh(115.0, metric=lat),
+                             base(100.0))["verdict"] == "fail"
+        assert evaluate_gate(fresh(90.0, metric=lat),
+                             base(100.0))["verdict"] == "pass"
+
+    def test_recorded_noise_widens_the_threshold(self):
+        # 12% regression fails at the default gate but passes when the
+        # measurement itself recorded 8% repeat spread (2x headroom)
+        v = evaluate_gate(fresh(88.0), base(100.0))
+        assert v["verdict"] == "fail"
+        v = evaluate_gate(fresh(88.0, noise_frac=0.08), base(100.0))
+        assert v["verdict"] != "fail"
+        assert v["effective_threshold"] == pytest.approx(0.16)
+
+    def test_device_mismatch_skips_not_fails(self):
+        v = evaluate_gate(fresh(10.0, device="cpu"),
+                          base(104034.1, device="TPU v5 lite0"))
+        assert v["verdict"] == "skip"
+        assert "device mismatch" in v["reason"]
+
+    def test_missing_baseline_skips(self):
+        assert evaluate_gate(fresh(100.0), None)["verdict"] == "skip"
+
+    def test_stale_fresh_result_skips(self):
+        v = evaluate_gate(fresh(100.0, stale=True, error="wedged"),
+                          base(100.0))
+        assert v["verdict"] == "skip"
+
+    def test_custom_threshold(self):
+        cfg = GateConfig(threshold=0.30, warn_threshold=0.25)
+        assert evaluate_gate(fresh(75.0), base(100.0),
+                             cfg)["verdict"] == "warn"
+        assert evaluate_gate(fresh(65.0), base(100.0),
+                             cfg)["verdict"] == "fail"
+
+
+# ---- the flight recorder ----
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestFlightRecorder:
+    def test_dump_round_trip_with_spans_and_snapshots(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("evidence_total").inc(7)
+        rec = FlightRecorder(registry=reg)
+        rec.configure(str(tmp_path))
+        try:
+            with span("incident_prelude", registry=reg, step=3):
+                pass
+            rec.tick()
+            path = rec.dump("test_fault", detail_key="v")
+            assert path is not None and path.endswith("flight-0000.json")
+            dump = json.loads(open(path).read())
+            assert dump["reason"] == "test_fault"
+            assert dump["detail"] == {"detail_key": "v"}
+            assert [s["name"] for s in dump["spans"]] == ["incident_prelude"]
+            assert dump["snapshots"][0]["metrics"][
+                "evidence_total"]["series"][""] == 7
+            # the dump-time snapshot rides along even without a tick
+            assert dump["final_snapshot"]["metrics"][
+                "evidence_total"]["series"][""] == 7
+        finally:
+            rec.close()
+
+    def test_sequential_numbering(self, tmp_path):
+        rec = FlightRecorder(registry=MetricsRegistry())
+        rec.configure(str(tmp_path))
+        try:
+            assert rec.dump("a").endswith("flight-0000.json")
+            assert rec.dump("b").endswith("flight-0001.json")
+        finally:
+            rec.close()
+
+    def test_window_eviction_with_fake_clock(self, tmp_path):
+        clk = FakeClock()
+        rec = FlightRecorder(registry=MetricsRegistry(), window_s=30.0,
+                             clock=clk)
+        rec.configure(str(tmp_path))
+        try:
+            rec.tick()          # t=1000
+            clk.t += 100.0
+            rec.tick()          # t=1100: the first snapshot is stale
+            dump = json.loads(open(rec.dump("evict")).read())
+            assert [s["time"] for s in dump["snapshots"]] == [1100.0]
+        finally:
+            rec.close()
+
+    def test_unconfigured_recorder_is_inert(self):
+        rec = FlightRecorder(registry=MetricsRegistry())
+        rec.tick()
+        assert rec.dump("nothing") is None
+
+    def test_supervisor_restart_dumps_preceding_spans(self, tmp_path,
+                                                      monkeypatch):
+        """The ISSUE-6 acceptance shape: a DEEPGO_FAULTS-injected
+        dispatcher kill produces a valid flight dump containing the spans
+        that preceded the fault."""
+        from deepgo_tpu.obs import sentinel
+        from deepgo_tpu.serving import (EngineConfig, InferenceEngine,
+                                        SupervisedEngine)
+        from deepgo_tpu.utils import faults
+
+        monkeypatch.setattr(sentinel, "_recorder", None)
+        sentinel.configure_flight(str(tmp_path))
+        faults.install("serving_dispatch:fail@1")
+        try:
+            with span("before_fault", registry=MetricsRegistry()):
+                pass
+
+            def forward(params, packed, player, rank):
+                return np.asarray(packed, np.float32).sum(axis=(1, 2, 3))
+
+            ecfg = EngineConfig(buckets=(1, 4), max_wait_ms=0.0)
+            sup = SupervisedEngine(
+                lambda: InferenceEngine(forward, None, ecfg, name="inner"),
+                name="flight-test", rng=random.Random(0))
+            try:
+                rng = np.random.default_rng(0)
+                board = rng.integers(0, 3, size=(9, 19, 19), dtype=np.uint8)
+                # the first dispatch hits the injected kill; the restart
+                # replays and the future still resolves
+                assert sup.submit(board, 1, 5, timeout_s=30.0).result(
+                    timeout=30.0) is not None
+            finally:
+                sup.close()
+            deadline = time.time() + 10.0
+            while not sentinel.get_flight_recorder().dumps \
+                    and time.time() < deadline:
+                time.sleep(0.05)  # the dump happens on the supervisor thread
+            dumps = sentinel.get_flight_recorder().dumps
+            assert dumps, "supervisor restart produced no flight dump"
+            dump = json.loads(open(dumps[0]).read())
+            assert dump["reason"] == "serving_restart"
+            assert dump["detail"]["engine"] == "flight-test"
+            assert "before_fault" in [s["name"] for s in dump["spans"]]
+        finally:
+            faults.reset()
+            sentinel.get_flight_recorder().close()
+            monkeypatch.setattr(sentinel, "_recorder", None)
+
+
+def test_watchdog_grace_signal_dumps_before_kill(tmp_path):
+    """arm(flight=True): a Python-level wedge gets SIGUSR1 one second
+    before the SIGKILL and leaves its black box behind."""
+    code = (
+        "import sys, time\n"
+        "sys.path.insert(0, sys.argv[1])\n"
+        "from deepgo_tpu.obs import sentinel\n"
+        "from deepgo_tpu.utils import watchdog\n"
+        "sentinel.configure_flight(sys.argv[2])\n"
+        "assert sentinel.install_signal_dump()\n"
+        "sentinel.get_flight_recorder().tick()\n"
+        "watchdog.arm('flight-test', timeout_s=1.0, flight=True)\n"
+        "time.sleep(60)\n"  # the wedge: never disarms
+        "print('UNREACHABLE')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code, REPO_ROOT, str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+        env={k: v for k, v in os.environ.items() if k != "PYTHONPATH"})
+    assert r.returncode == -9, (r.returncode, r.stderr[-500:])
+    assert "UNREACHABLE" not in r.stdout
+    dump = json.loads((tmp_path / "flight-0000.json").read_text())
+    assert dump["reason"] == "signal"
+    assert dump["snapshots"]  # the pre-wedge tick survived into the dump
+
+
+# ---- bench --gate integration (three quick CPU serving benches) ----
+
+
+def test_bench_gate_exit_codes_end_to_end(tmp_path):
+    """Clean run -> capture value; gate vs an inflated last-good fails
+    (exit 1, verdict in the single JSON line); gate vs a beatable
+    last-good passes (exit 0)."""
+    def run_bench(last_good_path, args=()):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
+                   BENCH_PREFLIGHT="0", BENCH_WATCHDOG="0",
+                   DEEPGO_FLIGHT="0",
+                   BENCH_LAST_GOOD=str(last_good_path))
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+             "--mode", "serving", *args],
+            capture_output=True, text=True, timeout=300, env=env)
+
+    proc = run_bench(tmp_path / "none.json")
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    clean = json.loads([l for l in proc.stdout.splitlines()
+                        if l.startswith("{")][0])
+    assert clean["value"] > 0
+
+    def table(baseline_value):
+        path = tmp_path / "last_good.json"
+        path.write_text(json.dumps({clean["metric"]: {
+            "metric": clean["metric"], "value": baseline_value,
+            "unit": "boards/sec", "device": clean["device"],
+            "timestamp": "2026-01-01T00:00:00Z", "git_sha": "abc"}}))
+        return path
+
+    # injected regression: the baseline claims 10x this machine's real
+    # throughput, so the fresh run reads >= 10% slower -> exit 1
+    proc = run_bench(table(clean["value"] * 10.0), args=["--gate"])
+    assert proc.returncode == 1, proc.stderr[-1500:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1  # the verdict rides INSIDE the one line
+    record = json.loads(lines[0])
+    assert record["gate"]["verdict"] == "fail"
+
+    # clean: the baseline is comfortably beatable -> exit 0
+    proc = run_bench(table(clean["value"] * 0.5), args=["--gate"])
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    record = json.loads([l for l in proc.stdout.splitlines()
+                         if l.startswith("{")][0])
+    assert record["gate"]["verdict"] == "pass"

@@ -1,0 +1,219 @@
+"""SLO burn-rate tracking (obs/slo.py).
+
+The ISSUE-6 coverage contract, all fake-clock (no sleeps): a fast burn
+trips before a slow burn, recovery walks fast_burn -> slow_burn -> ok as
+the windows drain, objectives read good/bad honestly from histograms /
+gauges / health probes, transitions stream slo_burn events and feed the
+deepgo_slo_burn_ratio gauge, and a burning SLO reads as degraded — but
+HTTP 200 — on /healthz.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from deepgo_tpu.obs import JsonlSink, MetricsRegistry, ObsExporter
+from deepgo_tpu.obs.report import read_events
+from deepgo_tpu.obs.slo import (GaugeFloorObjective, HealthObjective,
+                                HistogramLatencyObjective, SLOConfig,
+                                SloTracker, parse_slo_spec)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_tracker(objective, registry=None, sink=None, **cfg_kw):
+    cfg = SLOConfig(**{**dict(fast_window_s=60.0, slow_window_s=600.0,
+                              fast_burn=10.0, slow_burn=6.0), **cfg_kw})
+    clk = FakeClock()
+    tracker = SloTracker([objective], config=cfg,
+                         registry=registry or MetricsRegistry(),
+                         sink=sink, clock=clk)
+    return tracker, clk
+
+
+def tick(tracker, clk, n, dt=10.0):
+    out = None
+    for _ in range(n):
+        clk.advance(dt)
+        out = tracker.evaluate()
+    return out
+
+
+class TestBurnWindows:
+    def test_fast_burn_trips_before_slow_burn(self):
+        ok = {"v": True}
+        tracker, clk = make_tracker(
+            HealthObjective("avail", lambda: ok["v"], target=0.99))
+        tick(tracker, clk, 60)  # 600s of healthy history
+        assert tracker.states["avail"] == "ok"
+        ok["v"] = False
+        verdict = tick(tracker, clk, 1)["avail"]
+        # one bad tick: the 60s window burns hot, the 600s one does not
+        assert verdict["state"] == "fast_burn"
+        assert verdict["burn_fast"] >= 10.0
+        assert verdict["burn_slow"] < 6.0
+
+    def test_recovery_decays_fast_then_slow_then_ok(self):
+        ok = {"v": True}
+        tracker, clk = make_tracker(
+            HealthObjective("avail", lambda: ok["v"], target=0.99))
+        tick(tracker, clk, 60)
+        ok["v"] = False
+        tick(tracker, clk, 6)
+        assert tracker.states["avail"] == "fast_burn"
+        ok["v"] = True
+        tick(tracker, clk, 12)  # 120s: the bad ticks leave the fast window
+        assert tracker.states["avail"] == "slow_burn"
+        tick(tracker, clk, 60)  # 600s more: they leave the slow window too
+        assert tracker.states["avail"] == "ok"
+
+    def test_no_data_is_not_a_violation(self):
+        reg = MetricsRegistry()
+        tracker, clk = make_tracker(HistogramLatencyObjective(
+            "lat", "lat_seconds", 0.1, registry=reg), registry=reg)
+        verdict = tick(tracker, clk, 5)["lat"]
+        assert verdict["state"] == "ok"
+        assert verdict["burn_fast"] == 0.0
+
+    def test_transitions_emit_slo_burn_events(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        ok = {"v": True}
+        with JsonlSink(path) as sink:
+            tracker, clk = make_tracker(
+                HealthObjective("avail", lambda: ok["v"], target=0.99),
+                sink=sink)
+            tick(tracker, clk, 60)
+            ok["v"] = False
+            tick(tracker, clk, 2)
+            ok["v"] = True
+            tick(tracker, clk, 80)
+        kinds = [(r["from_state"], r["to_state"])
+                 for r in read_events(path) if r.get("kind") == "slo_burn"]
+        assert kinds[0] == ("ok", "fast_burn")
+        assert kinds[-1][1] == "ok"  # recovered in the end
+
+    def test_burn_gauge_updated_per_window(self):
+        reg = MetricsRegistry()
+        ok = {"v": True}
+        tracker, clk = make_tracker(
+            HealthObjective("avail", lambda: ok["v"], target=0.99),
+            registry=reg)
+        tick(tracker, clk, 60)
+        ok["v"] = False
+        tick(tracker, clk, 1)
+        g = reg.gauge("deepgo_slo_burn_ratio")
+        assert g.value(slo="avail", window="fast") >= 10.0
+        assert g.value(slo="avail", window="slow") > 0.0
+
+
+class TestObjectives:
+    def test_histogram_latency_counts_buckets_at_threshold(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=(0.05, 0.25, 1.0))
+        for v in (0.01, 0.2, 0.9):
+            h.observe(v, engine="e")
+        obj = HistogramLatencyObjective("lat", "lat_seconds", 0.25,
+                                        registry=reg)
+        good, total = obj.sample()
+        assert (good, total) == (2.0, 3.0)  # 0.9 misses the 0.25 bucket
+
+    def test_histogram_latency_label_filter(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=(0.1,))
+        h.observe(0.05, engine="a")
+        h.observe(0.05, engine="b")
+        obj = HistogramLatencyObjective("lat", "lat_seconds", 0.1,
+                                        registry=reg, engine="a")
+        assert obj.sample() == (1.0, 1.0)
+
+    def test_gauge_floor_skips_absent_then_judges(self):
+        reg = MetricsRegistry()
+        obj = GaugeFloorObjective("sps", "sps_gauge", floor=100.0,
+                                  registry=reg)
+        assert obj.sample() == (0.0, 0.0)  # never set: no verdict yet
+        reg.gauge("sps_gauge").set(150.0)
+        assert obj.sample() == (1.0, 1.0)
+        reg.gauge("sps_gauge").set(50.0)
+        assert obj.sample() == (1.0, 2.0)  # below floor: bad tick
+
+    def test_health_objective_counts_raising_probe_as_bad(self):
+        obj = HealthObjective("avail", lambda: 1 / 0, target=0.9)
+        assert obj.sample() == (0.0, 1.0)
+
+    def test_target_must_be_a_fraction(self):
+        with pytest.raises(ValueError, match="target"):
+            HealthObjective("x", lambda: True, target=1.0)
+
+
+class TestSpecGrammar:
+    def test_parse_known_objectives(self):
+        reg = MetricsRegistry()
+        objs = parse_slo_spec("dispatch_ms=50,train_sps=1000@0.95",
+                              registry=reg)
+        assert [o.name for o in objs] == ["serving_dispatch",
+                                         "train_throughput"]
+        assert objs[0].threshold_s == pytest.approx(0.05)
+        assert objs[1].floor == 1000.0 and objs[1].target == 0.95
+
+    def test_unknown_objective_fails_loudly(self):
+        with pytest.raises(ValueError, match="unknown SLO"):
+            parse_slo_spec("made_up=1")
+
+    def test_availability_requires_health_fn(self):
+        with pytest.raises(ValueError, match="availability"):
+            parse_slo_spec("availability=0.999")
+        objs = parse_slo_spec("availability=0.999",
+                              health_fn=lambda: {"healthy": True})
+        assert objs[0].name == "availability"
+
+
+class TestHealthzDegraded:
+    def test_burning_slo_reads_degraded_but_200(self):
+        ok = {"v": True}
+        tracker, clk = make_tracker(
+            HealthObjective("avail", lambda: ok["v"], target=0.99))
+        tick(tracker, clk, 60)
+        ok["v"] = False
+        tick(tracker, clk, 2)
+        assert tracker.states["avail"] == "fast_burn"
+        with ObsExporter(port=0, registry=MetricsRegistry()) as exp:
+            exp.add_health("slo", tracker.health)
+            with urllib.request.urlopen(exp.url + "/healthz",
+                                        timeout=5) as r:
+                assert r.status == 200  # degraded is NOT a 503
+                payload = json.loads(r.read().decode())
+        assert payload["healthy"] is True
+        assert payload["degraded"] is True
+        assert payload["components"]["slo"]["burning"] == {
+            "avail": "fast_burn"}
+
+
+def test_fast_burn_trips_flight_recorder(tmp_path, monkeypatch):
+    # entering fast_burn ships the black box (obs/sentinel.py)
+    from deepgo_tpu.obs import sentinel
+
+    monkeypatch.setattr(sentinel, "_recorder", None)
+    sentinel.configure_flight(str(tmp_path))
+    try:
+        ok = {"v": True}
+        tracker, clk = make_tracker(
+            HealthObjective("avail", lambda: ok["v"], target=0.99))
+        tick(tracker, clk, 60)
+        ok["v"] = False
+        tick(tracker, clk, 2)
+        dump = json.loads((tmp_path / "flight-0000.json").read_text())
+        assert dump["reason"] == "slo_fast_burn"
+        assert dump["detail"]["slo"] == "avail"
+    finally:
+        sentinel.get_flight_recorder().close()
+        monkeypatch.setattr(sentinel, "_recorder", None)
