@@ -1,8 +1,8 @@
 # Developer entry points. `scripts/setup.sh` chains native + data + test.
 
 .PHONY: native data test test-full verify verify-faults verify-serving \
-    verify-resilience verify-distributed verify-obs verify-slo bench \
-    bench-gate smoke clean
+    verify-resilience verify-fleet verify-distributed verify-obs \
+    verify-slo bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -28,6 +28,9 @@ verify-serving:  # batching engine: bucket bitwise parity, zero-recompile, lifec
 verify-resilience:  # fault-injected serving: restart+replay, poison isolation, breaker, shedding
 	JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q
 
+verify-fleet:  # fleet router: failover with exclusion, respawn, rolling hot reload, tier shedding
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+
 verify-distributed:  # multi-host elastic: liveness, deadlines, subprocess chaos recovery
 	JAX_PLATFORMS=cpu python -m pytest tests/test_liveness.py \
 	    tests/test_deadlines.py tests/test_elastic.py \
@@ -40,7 +43,7 @@ verify-slo:  # analysis layer: SLO burn windows, sentinel gate + flight recorder
 	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py tests/test_sentinel.py \
 	    tests/test_attribution.py -q
 
-verify: verify-faults verify-serving verify-resilience verify-distributed verify-obs verify-slo  # the full failure-model suite
+verify: verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -41,6 +41,18 @@ Extra modes (round-2 verdict items 2 and 5), each also one JSON line:
                    that actually resolved per second — plus the restart /
                    shed / poison counters, so the cost of surviving
                    failure is measured rather than asserted.
+  --mode serving --fleet N [--faults [SPEC]]
+                   the same workload through a FleetRouter of N
+                   supervised replicas (serving/fleet.py): submitters
+                   carry rotating priority tiers, a weight hot-reload
+                   rolls through the fleet mid-run, and the JSON reports
+                   per-tier outcomes + latency, failover/respawn
+                   counters, and reload-without-drop. With --faults the
+                   default spec kills one replica mid-run (max_restarts=0
+                   replicas, so the FLEET absorbs it: failover with
+                   exclusion + background respawn) and, when --obs-port
+                   is live, the /healthz 200→503→200 flip around the
+                   respawn is recorded in the JSON.
   --mode distributed [--faults [SPEC]]
                    2-host elastic training (CPU subprocesses over a shared
                    run dir; parallel/elastic.py). With --faults the victim
@@ -535,6 +547,12 @@ def _exit_gate(result: dict, args) -> None:
 # restart and poison-isolation paths absorb
 DEFAULT_CHAOS_FAULTS = "serving_dispatch:fail@3,serving_forward:transient@2"
 
+# default --fleet chaos: kill one replica's dispatcher mid-run (replicas
+# run with max_restarts=0, so the kill exhausts the supervisor and
+# exercises the FLEET domain — failover with exclusion + background
+# respawn) plus a transient routing fault the router absorbs
+DEFAULT_FLEET_FAULTS = "serving_dispatch:fail@4,fleet_route:transient@2"
+
 # default --mode distributed chaos: SIGKILL the victim host once its step
 # counter reaches 7 (the honest preemption; same site the PR 1
 # kill-and-resume test uses)
@@ -698,7 +716,7 @@ def _attach_obs(result: dict, exporter) -> None:
 
 
 def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
-                   exporter=None) -> dict:
+                   exporter=None, fleet: int | None = None) -> dict:
     """Micro-batching engine throughput under concurrent submitters.
 
     Unlike --mode inference (one giant pre-staged batch through a scan —
@@ -714,14 +732,26 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     installed via deepgo_tpu.utils.faults, the engine runs under the
     resilience supervisor, and the headline value becomes GOODPUT —
     requests that resolved successfully per second — with every typed
-    failure outcome (shed / poisoned / other) counted, not crashed on."""
+    failure outcome (shed / poisoned / other) counted, not crashed on.
+
+    ``fleet=N`` routes the same workload through a FleetRouter of N
+    supervised replicas (serving/fleet.py): submitters carry rotating
+    priority tiers (interactive/selfplay/batch), a weight hot-reload is
+    rolled through the fleet MID-RUN (same values, so numerics cannot
+    drift), and the JSON reports per-tier outcomes + latency, failover
+    and respawn counters, reload-without-drop, and — with an exporter —
+    the /healthz status transitions around the replica kill. Chaos fleet
+    replicas run with ``max_restarts=0`` so an injected dispatcher kill
+    exhausts the replica's own supervisor and exercises the FLEET
+    failure domain: failover with exclusion + background respawn."""
     import jax
 
     from deepgo_tpu.models import policy_cnn
     from deepgo_tpu.models.serving import make_log_prob_fn
-    from deepgo_tpu.serving import (CircuitOpen, EngineConfig,
-                                    EngineOverloaded, InferenceEngine,
-                                    PoisonedRequest, SupervisedEngine)
+    from deepgo_tpu.serving import (TIERS, CircuitOpen, EngineConfig,
+                                    EngineOverloaded, FleetRouter,
+                                    InferenceEngine, PoisonedRequest,
+                                    SupervisedEngine, SupervisorConfig)
 
     if on_tpu:
         name, submitters, per_thread = "full", 32, 512
@@ -729,6 +759,9 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     else:
         name, submitters, per_thread = "small", 4, 32
         buckets = (1, 8, 32)
+    if fleet:
+        # enough submitters that every tier appears even on the CPU smoke
+        submitters = max(submitters, 6)
     cfg = policy_cnn.CONFIGS[name]
     params = policy_cnn.init(jax.random.key(0), cfg)
     forward = make_log_prob_fn(cfg)
@@ -737,16 +770,31 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         from deepgo_tpu.utils import faults as faults_mod
 
         faults_mod.install(faults_spec)
+    if fleet:
+        sup = (SupervisorConfig(max_restarts=0, backoff_base_s=0.01,
+                                backoff_cap_s=0.1)
+               if faults_spec else None)
+
+        def make_replica(i: int) -> SupervisedEngine:
+            return SupervisedEngine(
+                lambda: InferenceEngine(forward, params, ecfg,
+                                        name=f"bench-{i}"),
+                config=sup, name=f"bench-{i}")
+
+        engine = FleetRouter(make_replica, fleet, name="bench-fleet")
+    elif faults_spec:
         engine = SupervisedEngine(
             lambda: InferenceEngine(forward, params, ecfg, name="bench"),
             name="bench")
     else:
         engine = InferenceEngine(forward, params, ecfg, name="bench")
     slo_tracker = None
+    healthz_codes: list[tuple[float, int]] = []
+    healthz_stop = None
     if exporter is not None:
-        if faults_spec:
+        if faults_spec or fleet:
             # the chaos bench is scrapeable live: /healthz serves the
-            # supervisor's verdict while faults fire
+            # supervisor's (or fleet's) verdict while faults fire
             from deepgo_tpu.obs import health_from_engine
 
             exporter.add_health("serving", health_from_engine(engine))
@@ -763,74 +811,176 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
 
     import threading
 
+    if exporter is not None and fleet and faults_spec:
+        # record the /healthz flip around the replica kill + respawn:
+        # the acceptance shape is 200 -> 503 (replica down) -> 200
+        import urllib.request
+
+        healthz_stop = threading.Event()
+
+        def poll_healthz() -> None:
+            url = exporter.url + "/healthz"
+            while not healthz_stop.is_set():
+                try:
+                    with urllib.request.urlopen(url, timeout=1.0) as r:
+                        code = r.status
+                except urllib.error.HTTPError as e:
+                    code = e.code
+                except Exception:
+                    code = -1
+                if not healthz_codes or healthz_codes[-1][1] != code:
+                    healthz_codes.append((round(time.time(), 3), code))
+                healthz_stop.wait(0.02)
+
+        threading.Thread(target=poll_healthz, daemon=True).start()
+
     rng = np.random.default_rng(0)
     packed, player, rank = _rand_batch(rng, (submitters,))
     errors = []
     lock = threading.Lock()
-    outcomes = {"ok": 0, "shed": 0, "poisoned": 0, "failed": 0}
+    tiers = [TIERS[i % len(TIERS)] for i in range(submitters)] \
+        if fleet else [None] * submitters
+    blank = {"ok": 0, "shed": 0, "poisoned": 0, "failed": 0}
+    outcomes = dict(blank)
+    tier_outcomes = {t: dict(blank) for t in TIERS} if fleet else None
+    done_count = [0]
 
     def submitter(i: int) -> None:
         for _ in range(per_thread):
             try:
-                engine.submit(packed[i], int(player[i]),
-                              int(rank[i])).result()
+                if fleet:
+                    engine.submit(packed[i], int(player[i]), int(rank[i]),
+                                  tier=tiers[i], timeout_s=30.0).result()
+                else:
+                    engine.submit(packed[i], int(player[i]),
+                                  int(rank[i])).result()
                 kind = "ok"
             except (EngineOverloaded, CircuitOpen):
                 kind = "shed"
             except PoisonedRequest:
                 kind = "poisoned"
             except BaseException as e:  # noqa: BLE001 — reported in the JSON
-                if faults_spec is None:
+                if faults_spec is None and not fleet:
                     errors.append(f"{type(e).__name__}: {e}")
                     return
                 errors.append(f"{type(e).__name__}: {e}")
                 kind = "failed"
             with lock:
                 outcomes[kind] += 1
+                done_count[0] += 1
+                if tier_outcomes is not None:
+                    tier_outcomes[tiers[i]][kind] += 1
+
+    boards = submitters * per_thread
+    reload_report = None
+    reload_thread = None
+    if fleet:
+        # roll a weight hot-swap through the fleet MID-RUN, with the same
+        # values (np copies), so every in-flight request stays bit-stable
+        # whichever side of the swap it lands on — the reload-without-
+        # drop proof rides inside the throughput run
+        same_params = jax.tree.map(lambda x: np.array(x), params)
+
+        def reloader() -> None:
+            while True:
+                with lock:
+                    if done_count[0] >= boards // 3:
+                        break
+                time.sleep(0.005)
+            t0 = time.time()
+            try:
+                out = engine.reload(same_params)
+                reload_report.update(
+                    ok=True, replicas=out["replicas"],
+                    seconds=round(time.time() - t0, 4))
+            except Exception as e:  # noqa: BLE001 — reported in the JSON
+                reload_report.update(ok=False, error=repr(e))
+
+        reload_report = {"ok": None}
+        reload_thread = threading.Thread(target=reloader, daemon=True)
 
     t0 = time.time()
     threads = [threading.Thread(target=submitter, args=(i,))
                for i in range(submitters)]
     for t in threads:
         t.start()
+    if reload_thread is not None:
+        reload_thread.start()
     for t in threads:
         t.join()
+    if reload_thread is not None:
+        reload_thread.join(timeout=60)
     dt = time.time() - t0
     stats = engine.stats()
-    health = engine.health() if faults_spec else None
+    health = engine.health() if (faults_spec or fleet) else None
     if slo_tracker is not None:
         slo_tracker.stop()
+    if healthz_stop is not None:
+        healthz_stop.set()
     engine.close()
-    boards = submitters * per_thread
     goodput = outcomes["ok"] / dt
-    result = {
-        "metric": ("serving_engine_goodput_under_faults_boards_per_sec"
-                   if faults_spec else
-                   "serving_engine_boards_per_sec_per_chip"),
-        "value": round(goodput if faults_spec else boards / dt, 1),
-        "unit": "boards/sec",
-        "vs_baseline": round(
-            (goodput if faults_spec else boards / dt)
-            / BASELINE_BOARDS_PER_SEC, 3),
-        "model": f"{name} policy CNN via micro-batching engine",
-        "submitters": submitters,
-        "requests_per_submitter": per_thread,
-        "batch_occupancy": stats["occupancy"],
-        "bucket_hits": stats["bucket_hits"],
-        "p50_ms": stats["p50_ms"],
-        "p99_ms": stats["p99_ms"],
-    }
-    if faults_spec:
-        result.update({
-            "faults": faults_spec,
+    if fleet:
+        fstats = stats["fleet"]
+        result = {
+            "metric": ("serving_fleet_goodput_under_faults_boards_per_sec"
+                       if faults_spec else
+                       "serving_fleet_boards_per_sec_per_chip"),
+            "value": round(goodput if faults_spec else boards / dt, 1),
+            "unit": "boards/sec",
+            "vs_baseline": round(
+                (goodput if faults_spec else boards / dt)
+                / BASELINE_BOARDS_PER_SEC, 3),
+            "model": f"{name} policy CNN via {fleet}-replica fleet router",
+            "replicas": fleet,
+            "submitters": submitters,
+            "requests_per_submitter": per_thread,
             "submitted": boards,
             "outcomes": outcomes,
-            "restarts": health["restarts"],
-            "shed_overload": health["shed_overload"],
-            "shed_breaker": health["shed_breaker"],
-            "poisoned": health["poisoned"],
-            "breaker": health["breaker"]["state"],
-        })
+            "tiers": {t: {**tier_outcomes[t], **fstats["tiers"][t]}
+                      for t in TIERS},
+            "shed_by_tier": fstats["shed"],
+            "failovers": fstats["failovers"],
+            "failover_p50_ms": fstats["failover_p50_ms"],
+            "respawns": fstats["respawns"],
+            "reloads": fstats["reloads"],
+            "reload": reload_report,
+            "replicas_serving": health["replicas_serving"],
+            "fleet_state": health["state"],
+        }
+        if faults_spec:
+            result["faults"] = faults_spec
+        if healthz_codes:
+            result["healthz_transitions"] = [
+                {"time": t, "status": c} for t, c in healthz_codes]
+    else:
+        result = {
+            "metric": ("serving_engine_goodput_under_faults_boards_per_sec"
+                       if faults_spec else
+                       "serving_engine_boards_per_sec_per_chip"),
+            "value": round(goodput if faults_spec else boards / dt, 1),
+            "unit": "boards/sec",
+            "vs_baseline": round(
+                (goodput if faults_spec else boards / dt)
+                / BASELINE_BOARDS_PER_SEC, 3),
+            "model": f"{name} policy CNN via micro-batching engine",
+            "submitters": submitters,
+            "requests_per_submitter": per_thread,
+            "batch_occupancy": stats["occupancy"],
+            "bucket_hits": stats["bucket_hits"],
+            "p50_ms": stats["p50_ms"],
+            "p99_ms": stats["p99_ms"],
+        }
+        if faults_spec:
+            result.update({
+                "faults": faults_spec,
+                "submitted": boards,
+                "outcomes": outcomes,
+                "restarts": health["restarts"],
+                "shed_overload": health["shed_overload"],
+                "shed_breaker": health["shed_breaker"],
+                "poisoned": health["poisoned"],
+                "breaker": health["breaker"]["state"],
+            })
     if errors:
         result["error"] = "; ".join(sorted(set(errors))[:3])
     return result
@@ -847,11 +997,20 @@ def main() -> None:
                     default=None, metavar="SPEC",
                     help="(--mode serving / distributed) chaos run: install "
                          "this DEEPGO_FAULTS spec (serving default: "
-                         f"'{DEFAULT_CHAOS_FAULTS}'; distributed default: "
+                         f"'{DEFAULT_CHAOS_FAULTS}'; with --fleet: "
+                         f"'{DEFAULT_FLEET_FAULTS}'; distributed default: "
                          f"'{DEFAULT_DIST_FAULTS}', given to the victim "
                          "host). Serving reports goodput + restart/shed/"
                          "poison counters; distributed reports recovery "
                          "latency + steps lost")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="(--mode serving) route the workload through a "
+                         "FleetRouter of N supervised replicas with "
+                         "tiered submitters and a mid-run weight "
+                         "hot-reload; reports per-tier outcomes/latency, "
+                         "failover + respawn counters, and "
+                         "reload-without-drop (with --faults: replica "
+                         "kill chaos + /healthz flip tracking)")
     ap.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                     help="serve live /metrics + /healthz while the bench "
                          "runs (0 = ephemeral port) and attach the final "
@@ -868,8 +1027,13 @@ def main() -> None:
     args = ap.parse_args()
     if args.faults is not None and args.mode not in ("serving", "distributed"):
         ap.error("--faults only applies to --mode serving or distributed")
+    if args.fleet is not None and args.mode != "serving":
+        ap.error("--fleet only applies to --mode serving")
+    if args.fleet is not None and args.fleet < 2:
+        ap.error("--fleet needs N >= 2 (a 1-replica fleet is --faults)")
     if args.faults == "__default__":
         args.faults = (DEFAULT_DIST_FAULTS if args.mode == "distributed"
+                       else DEFAULT_FLEET_FAULTS if args.fleet
                        else DEFAULT_CHAOS_FAULTS)
 
     obs_exporter = None
@@ -919,7 +1083,8 @@ def main() -> None:
     if args.mode != "inference":
         if args.mode == "serving":
             result = _bench_serving(on_tpu, args.faults,
-                                    exporter=obs_exporter)
+                                    exporter=obs_exporter,
+                                    fleet=args.fleet)
         else:
             fn = {"train": _bench_train, "latency": _bench_latency,
                   "large": _bench_large}[args.mode]

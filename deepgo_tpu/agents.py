@@ -623,26 +623,30 @@ class Value2PlyAgent(ValueSearchAgent):
                             tie_scale=1e-4)
 
 
-def _policy_engine_for(params, cfg, use_engine):
+def _policy_engine_for(params, cfg, use_engine, fleet: int = 1):
     """The shared policy engine for this checkpoint, or None. Agents built
     from the same params then coalesce their per-ply forwards into the
     same micro-batched dispatches (serving.shared_policy_engine).
     ``use_engine="supervised"`` puts the shared engine under the
     resilience supervisor (serving.SupervisedEngine) so agents ride
-    through dispatcher restarts untouched."""
+    through dispatcher restarts untouched; ``fleet >= 2`` spreads it over
+    that many supervised replicas behind the failover router
+    (serving.FleetRouter — docs/serving.md)."""
     if not use_engine:
         return None
     from .serving import shared_policy_engine
 
     return shared_policy_engine(params, cfg,
-                                supervised=use_engine == "supervised")
+                                supervised=use_engine == "supervised",
+                                fleet=fleet)
 
 
 def _make_agent(spec: str, seed: int, temperature: float = 0.0,
-                rank: int = 9, use_engine=False) -> Agent:
+                rank: int = 9, use_engine=False, fleet: int = 1) -> Agent:
     """``use_engine``: False (direct ladder path), True (shared
     micro-batching engine), or "supervised" (shared engine under the
-    resilience supervisor)."""
+    resilience supervisor). ``fleet >= 2`` upgrades the shared engines to
+    a FleetRouter of that many supervised replicas."""
     if spec == "random":
         return RandomAgent()
     if spec == "heuristic":
@@ -655,7 +659,8 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         _, params, cfg = load_policy(spec.split(":", 1)[1])
         return PolicyAgent(params, cfg, name="policy", temperature=temperature,
                            rank=rank,
-                           engine=_policy_engine_for(params, cfg, use_engine))
+                           engine=_policy_engine_for(params, cfg, use_engine,
+                                                     fleet=fleet))
     if spec.startswith("search:"):
         from .models.serving import load_policy
 
@@ -665,13 +670,15 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         _, params, cfg = load_policy(spec.split(":", 1)[1])
         return PolicySearchAgent(params, cfg, rank=rank,
                                  engine=_policy_engine_for(params, cfg,
-                                                           use_engine))
+                                                           use_engine,
+                                                           fleet=fleet))
     if spec.startswith("search2:"):
         from .models.serving import load_policy
 
         _, params, cfg = load_policy(spec.split(":", 1)[1])
         return TwoPlyAgent(params, cfg, rank=rank,
-                           engine=_policy_engine_for(params, cfg, use_engine))
+                           engine=_policy_engine_for(params, cfg, use_engine,
+                                                     fleet=fleet))
     if spec.startswith(("value:", "value2:")):
         from .models.serving import load_policy, load_value
 
@@ -690,16 +697,19 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
             from .serving import shared_value_engine
 
             value_engine = shared_value_engine(
-                vparams, vcfg, supervised=use_engine == "supervised")
+                vparams, vcfg, supervised=use_engine == "supervised",
+                fleet=fleet)
         return cls(params, cfg, vparams, vcfg, rank=rank,
-                   engine=_policy_engine_for(params, cfg, use_engine),
+                   engine=_policy_engine_for(params, cfg, use_engine,
+                                             fleet=fleet),
                    value_engine=value_engine)
     if spec.startswith("model:"):  # random-init policy, for smoke runs
         cfg = policy_cnn.CONFIGS[spec.split(":", 1)[1]]
         params = policy_cnn.init(jax.random.key(seed), cfg)
         return PolicyAgent(params, cfg, name=f"init-{spec.split(':', 1)[1]}",
                            temperature=temperature, rank=rank,
-                           engine=_policy_engine_for(params, cfg, use_engine))
+                           engine=_policy_engine_for(params, cfg, use_engine,
+                                                     fleet=fleet))
     raise ValueError(
         f"unknown agent spec {spec!r} "
         "(use random | heuristic | oneply | checkpoint:PATH | search:PATH "

@@ -183,16 +183,23 @@ def main(argv=None) -> None:
                          "auto-restart with replay, batch-poison "
                          "isolation, circuit breaker, deadline shedding "
                          "(docs/robustness.md)")
+    ap.add_argument("--fleet", type=int, default=1, metavar="N",
+                    help="like --supervised, but the shared engines become "
+                         "a FleetRouter of N supervised replicas: "
+                         "least-wait placement, failover with exclusion, "
+                         "background respawn, tiered QoS "
+                         "(docs/serving.md)")
     args = ap.parse_args(argv)
 
     from .utils import honor_platform_env
 
     honor_platform_env()
-    use_engine = "supervised" if args.supervised else args.engine
+    use_engine = ("supervised" if args.supervised
+                  else args.engine or args.fleet > 1)
     agent_a = _make_agent(args.a, args.seed, args.temperature, args.rank,
-                          use_engine=use_engine)
+                          use_engine=use_engine, fleet=args.fleet)
     agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank,
-                          use_engine=use_engine)
+                          use_engine=use_engine, fleet=args.fleet)
     try:
         games, scores, stats = play_match(
             agent_a, agent_b, n_games=args.games, komi=args.komi,

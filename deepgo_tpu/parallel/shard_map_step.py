@@ -19,12 +19,43 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+# jax moved shard_map out of experimental around 0.6; support both homes
+# and degrade to None (shard_map_available / a loud call-time ImportError)
+# rather than killing every importer's collection on older installs
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover — depends on installed jax
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError:
+        _shard_map = None
 
 from ..models import policy_cnn
 from ..ops import get_expand_fn
 from ..training.optimizers import Optimizer
 from ..training.steps import nll_from_logits
+
+
+def shard_map_available() -> bool:
+    """Whether the installed jax exposes shard_map at all (tests skip
+    instead of erroring at collection when it doesn't)."""
+    return _shard_map is not None
+
+
+def _wrap_shard_map(f, mesh, in_specs, out_specs):
+    """Call shard_map across the replication-check keyword rename
+    (check_rep in older jax, check_vma in newer)."""
+    if _shard_map is None:
+        raise ImportError(
+            "this jax installation exposes neither jax.shard_map nor "
+            "jax.experimental.shard_map")
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
 
 
 def make_shard_map_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
@@ -57,12 +88,11 @@ def make_shard_map_train_step(cfg: policy_cnn.ModelConfig, optimizer: Optimizer,
         params, opt_state = optimizer.update(params, grads, opt_state)
         return params, opt_state, loss
 
-    mapped = shard_map(
+    mapped = _wrap_shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(), P(), batch_spec),
         out_specs=(P(), P(), P()),
-        check_vma=False,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))

@@ -212,7 +212,7 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
               max_moves: int = 361, temperature: float = 0.0,
               pass_threshold: float = 1e-4, rank: int = 9, seed: int = 0,
               engine=None, max_wait_ms: float = 2.0,
-              supervised: bool = False):
+              supervised: bool = False, fleet: int = 0):
     """Play n_games to completion; returns (games, stats).
 
     Inference rides the micro-batching engine (deepgo_tpu.serving): each
@@ -229,15 +229,25 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
     isolation, breaker, deadline shedding — docs/robustness.md): games
     then ride through dispatcher deaths untouched, with bit-identical
     results (the forward is pure, replay is idempotent).
-    ``stats["engine"]`` carries the engine's occupancy/latency/bucket
-    counters (plus the supervisor's restart/shed/poison counters when
-    supervised).
+    ``fleet >= 2`` spreads the games over that many supervised replicas
+    behind a FleetRouter (serving/fleet.py) — requests ride the
+    ``selfplay`` priority tier, so an overloaded shared fleet sheds them
+    before interactive traffic. ``stats["engine"]`` carries the engine's
+    occupancy/latency/bucket counters (plus the supervisor's
+    restart/shed/poison counters when supervised, or the fleet's
+    failover/respawn/shed counters with ``fleet``).
     """
     own_engine = engine is None
     if own_engine:
         ecfg = EngineConfig(buckets=ladder_for(n_games).buckets,
                             max_wait_ms=max_wait_ms)
-        if supervised:
+        if fleet and fleet >= 2:
+            from .serving import FleetConfig, fleet_policy_engine
+
+            engine = fleet_policy_engine(
+                params, cfg, replicas=fleet, config=ecfg,
+                fleet=FleetConfig(default_tier="selfplay"))
+        elif supervised:
             from .serving import supervised_policy_engine
 
             engine = supervised_policy_engine(params, cfg, config=ecfg)
@@ -336,6 +346,12 @@ def main(argv=None) -> None:
                          "replay, batch-poison isolation, circuit "
                          "breaker, deadline-aware shedding "
                          "(docs/robustness.md)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="spread inference over N supervised engine "
+                         "replicas behind the failover router "
+                         "(serving/fleet.py): least-wait placement, "
+                         "replica respawn, selfplay-tier QoS "
+                         "(docs/serving.md)")
     args = ap.parse_args(argv)
 
     from .utils import honor_platform_env
@@ -354,7 +370,7 @@ def main(argv=None) -> None:
                              max_moves=args.max_moves,
                              temperature=args.temperature, seed=args.seed,
                              max_wait_ms=args.max_wait_ms,
-                             supervised=args.supervised)
+                             supervised=args.supervised, fleet=args.fleet)
     print({k: round(v, 2) if isinstance(v, float) else v
            for k, v in stats.items()})
 

@@ -16,6 +16,11 @@ The layer every inference workload calls into (ROADMAP north star:
     requests replayed), batch-poison isolation (solo-lane bisection +
     atomic quarantine dump), a closed/open/half-open circuit breaker,
     and deadline-aware admission control (docs/robustness.md).
+  * fleet.py — N supervised replicas behind one ``FleetRouter``:
+    least-estimated-wait placement, failover with exclusion, background
+    replica respawn, rolling in-place weight hot-swap (``reload``), and
+    priority tiers (interactive > selfplay > batch) whose overload
+    shedding drains the cheap tier first (docs/serving.md).
 
 Factories below wire the engine to the models; ``shared_policy_engine`` /
 ``shared_value_engine`` memoize per (params, config) so mixed workloads —
@@ -34,6 +39,8 @@ from .resilience import (CircuitBreaker, CircuitOpen,  # noqa: F401
                          EngineOverloaded, PoisonedRequest,
                          RestartsExhausted, full_jitter_delay)
 from .supervisor import SupervisedEngine, SupervisorConfig  # noqa: F401
+from .fleet import (TIERS, FailoverExhausted, FleetConfig,  # noqa: F401
+                    FleetReloadError, FleetRouter, FleetUnavailable)
 
 
 def ladder_for(n_games: int, buckets=DEFAULT_BUCKETS) -> BucketLadder:
@@ -101,6 +108,51 @@ def supervised_value_engine(params, cfg,
         config=supervisor, name=name, metrics=metrics)
 
 
+def fleet_policy_engine(params, cfg, replicas: int = 2,
+                        config: EngineConfig | None = None,
+                        fleet: FleetConfig | None = None,
+                        supervisor: SupervisorConfig | None = None,
+                        expand_backend: str = "xla", metrics=None,
+                        name: str = "policy-fleet") -> FleetRouter:
+    """A FleetRouter of N supervised policy replicas sharing ONE jitted
+    forward — so warmup compiles each ladder rung once for the whole
+    fleet, and restarts, respawns, and ``reload`` weight swaps all reuse
+    the warm jit cache (zero recompiles, the hot-reload contract)."""
+    from ..models.serving import make_log_prob_fn
+
+    forward = make_log_prob_fn(cfg, expand_backend)
+
+    def make_replica(i: int) -> SupervisedEngine:
+        return SupervisedEngine(
+            lambda: InferenceEngine(forward, params, config=config,
+                                    name=f"{name}-{i}", metrics=metrics),
+            config=supervisor, name=f"{name}-{i}", metrics=metrics)
+
+    return FleetRouter(make_replica, replicas, config=fleet, name=name,
+                       metrics=metrics)
+
+
+def fleet_value_engine(params, cfg, replicas: int = 2,
+                       config: EngineConfig | None = None,
+                       fleet: FleetConfig | None = None,
+                       supervisor: SupervisorConfig | None = None,
+                       metrics=None,
+                       name: str = "value-fleet") -> FleetRouter:
+    """FleetRouter over the value forward (see fleet_policy_engine)."""
+    from ..models.serving import make_value_fn
+
+    forward = make_value_fn(cfg)
+
+    def make_replica(i: int) -> SupervisedEngine:
+        return SupervisedEngine(
+            lambda: InferenceEngine(forward, params, config=config,
+                                    name=f"{name}-{i}", metrics=metrics),
+            config=supervisor, name=f"{name}-{i}", metrics=metrics)
+
+    return FleetRouter(make_replica, replicas, config=fleet, name=name,
+                       metrics=metrics)
+
+
 # One engine per live (params, model config, engine config): agents built
 # from the same checkpoint — a policy player and the value searcher's
 # prior, both sides of a self-match — coalesce into the same dispatches.
@@ -108,28 +160,38 @@ _SHARED: dict[tuple, InferenceEngine] = {}
 
 
 def _shared(kind: str, factory, params, cfg, config: EngineConfig | None,
-            supervised: bool):
-    key = (kind, supervised, id(params), cfg, config)
+            supervised: bool, fleet: int = 1):
+    key = (kind, supervised, fleet, id(params), cfg, config)
     engine = _SHARED.get(key)
     if (engine is None or engine._closing.is_set()
             or getattr(engine, "_failed", None) is not None):
-        engine = _SHARED[key] = factory(params, cfg, config=config,
-                                        name=f"shared-{kind}")
+        if fleet > 1:
+            fleet_factory = (fleet_policy_engine if kind == "policy"
+                             else fleet_value_engine)
+            engine = _SHARED[key] = fleet_factory(
+                params, cfg, replicas=fleet, config=config,
+                name=f"shared-{kind}-fleet")
+        else:
+            engine = _SHARED[key] = factory(params, cfg, config=config,
+                                            name=f"shared-{kind}")
     return engine
 
 
 def shared_policy_engine(params, cfg, config: EngineConfig | None = None,
-                         supervised: bool = False):
+                         supervised: bool = False, fleet: int = 1):
+    """``fleet > 1`` returns a FleetRouter of that many supervised
+    replicas (replica supervision is implied — every replica is a
+    SupervisedEngine); otherwise the single shared engine as before."""
     return _shared("policy",
                    supervised_policy_engine if supervised else policy_engine,
-                   params, cfg, config, supervised)
+                   params, cfg, config, supervised, fleet)
 
 
 def shared_value_engine(params, cfg, config: EngineConfig | None = None,
-                        supervised: bool = False):
+                        supervised: bool = False, fleet: int = 1):
     return _shared("value",
                    supervised_value_engine if supervised else value_engine,
-                   params, cfg, config, supervised)
+                   params, cfg, config, supervised, fleet)
 
 
 def close_shared_engines() -> None:

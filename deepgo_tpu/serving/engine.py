@@ -186,6 +186,24 @@ class InferenceEngine:
         cache_size = getattr(self._forward, "_cache_size", None)
         return cache_size() if callable(cache_size) else None
 
+    @property
+    def params(self):
+        """The weights the next dispatch will use (see set_params)."""
+        return self._params
+
+    def set_params(self, params) -> None:
+        """In-place weight hot-swap: a pure pointer swap, no pause.
+
+        The dispatcher reads ``self._params`` once per dispatch, so an
+        atomic attribute assignment is the entire protocol — in-flight
+        dispatches finish on the weights they started with, the next
+        dispatch picks up the new ones, and nothing recompiles as long as
+        the new pytree matches the old one's structure/shapes/dtypes (the
+        jit cache is keyed on those, and the bucket ladder shapes never
+        change). The fleet reload path (serving/fleet.py) drains a
+        replica first so a request's weights are never ambiguous."""
+        self._params = params
+
     def _check_alive(self) -> None:
         if self._error is not None:
             raise EngineError(

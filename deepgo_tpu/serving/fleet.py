@@ -1,0 +1,798 @@
+"""FleetRouter: N supervised engine replicas behind one failure-absorbing
+front door.
+
+One ``SupervisedEngine`` survives everything PR 3 threw at it, but it is
+still one dispatcher on one chip — a single failure domain and a single
+chip's ceiling. FireCaffe's scale-out framing (arXiv:1511.00175) says the
+serving answer is N replicas whose aggregate absorbs the failure of any
+one of them, and at fleet sizes failure is the steady state, so the
+router — not the operator — must do the absorbing. This module is that
+router, plus the two things a fleet needs that a single engine does not:
+
+  placement   least-estimated-wait: each submit routes to the serving
+              replica whose ``estimated_wait_s()`` (rolling p50 dispatch
+              latency x pending windows, the PR 3 admission estimate) is
+              smallest, with pending-count and round-robin tie-breaks, so
+              load follows capacity instead of a static hash.
+  failover    a request whose replica dies under it (RestartsExhausted,
+              dispatcher death past the replica's restart budget, closed
+              mid-flight) or trips its breaker is transparently re-routed
+              to a healthy replica WITH EXCLUSION — the failed replica is
+              struck from that request's candidate set, so a poisoned
+              placement can't bounce back to the same corpse. Retries are
+              bounded (``max_failovers``); a ``PoisonedRequest`` is final
+              — the request's own content fails the forward, and retrying
+              it fleet-wide would poison every replica in turn.
+  respawn     a replica that exhausted its supervisor's restart budget is
+              rebuilt in the background (bounded full-jitter backoff, the
+              resilience.py discipline) while traffic routes around it;
+              the fleet never blocks a caller on a rebuild.
+  hot reload  ``reload(params_or_checkpoint)`` rolls new weights through
+              the replicas ONE AT A TIME: drain (placement skips the
+              replica, its in-flight requests finish on the old weights),
+              pointer-swap the params into the warm jit cache
+              (``set_params`` — the bucket ladder shapes are unchanged,
+              so nothing recompiles), rejoin. In-flight futures never
+              drop and the fleet never goes below N-1 capacity.
+  QoS tiers   every request carries a priority class, ``interactive >
+              selfplay > batch``. Fleet admission control sheds the cheap
+              tier first: each tier's headroom factor scales how much of
+              its deadline the estimated queue wait may consume before
+              the request is shed at the door (batch sheds at 30% of its
+              deadline, interactive only when the deadline is genuinely
+              unmeetable), with per-tier shed counters and a ``tier``
+              label on the request-latency histogram.
+
+Fault sites: ``fleet_route`` fires inside each placement attempt (an
+injected fault there is absorbed like a replica failure — excluded,
+re-routed, counted); ``fleet_reload`` fires per replica swap during a
+rolling reload (a fault surfaces as a typed ``FleetReloadError`` while
+the replica rejoins and the fleet keeps serving).
+
+The contract is the supervisor's, widened to the fleet: every submitted
+future RESOLVES — a result (possibly after transparent failovers and
+respawns), or a typed shed / poison / timeout / exhaustion — never a
+stranded waiter. Clock, sleep, and RNG are injectable; the chaos tests
+drive every transition deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import random
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..obs import get_registry
+from ..obs.sentinel import flight_dump
+from ..utils import faults
+from .engine import EngineBusy, EngineClosed, EngineError
+from .resilience import (CircuitOpen, EngineOverloaded, PoisonedRequest,
+                         full_jitter_delay)
+
+# priority classes, most- to least-important: overload sheds from the
+# right end first (per-tier headroom factors in FleetConfig)
+TIERS = ("interactive", "selfplay", "batch")
+
+
+class FleetUnavailable(EngineError):
+    """No replica could take the request: everything is failed,
+    respawning, or excluded by this request's own failover history."""
+
+
+class FailoverExhausted(EngineError):
+    """The request's bounded failover budget ran out; the last replica
+    failure rides as ``__cause__``."""
+
+
+class FleetReloadError(EngineError):
+    """A rolling weight reload failed mid-roll. Replicas already swapped
+    keep the new weights, the failing replica rejoined on its old ones,
+    and every later respawn/restart converges on the new checkpoint —
+    re-invoking ``reload`` is idempotent."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Knobs for one FleetRouter.
+
+    ``*_headroom`` is the fraction of a request's deadline the estimated
+    fleet queue wait may consume before that tier is shed at admission:
+    1.0 sheds interactive only when its deadline is already unmeetable,
+    while batch backs off at 30% — so overload drains the cheap tier
+    first and the expensive tier last. ``max_failovers`` bounds how many
+    replica FAILURES one request may ride through (shed-reroutes don't
+    count); ``max_respawns`` bounds CONSECUTIVE background rebuilds of
+    one replica (any request it serves resets the count)."""
+
+    max_failovers: int = 3
+    default_tier: str = "interactive"
+    interactive_headroom: float = 1.0
+    selfplay_headroom: float = 0.6
+    batch_headroom: float = 0.3
+    admission_control: bool = True
+    min_serving: int = 1
+    max_respawns: int = 8
+    respawn_base_s: float = 0.05
+    respawn_cap_s: float = 2.0
+    warm_on_respawn: bool = True
+    drain_timeout_s: float = 30.0
+
+    def headroom(self, tier: str) -> float:
+        return {"interactive": self.interactive_headroom,
+                "selfplay": self.selfplay_headroom,
+                "batch": self.batch_headroom}[tier]
+
+
+class _FleetRequest:
+    __slots__ = ("packed", "player", "rank", "tier", "deadline", "future",
+                 "excluded", "failovers", "t_submit", "t_first_failure",
+                 "last_error")
+
+    def __init__(self, packed, player, rank, tier, deadline, t_submit):
+        self.packed = packed
+        self.player = player
+        self.rank = rank
+        self.tier = tier
+        self.deadline = deadline          # absolute, router clock
+        self.future: Future = Future()
+        self.excluded: set[int] = set()   # replicas this request fled
+        self.failovers = 0
+        self.t_submit = t_submit
+        self.t_first_failure: float | None = None
+        self.last_error: BaseException | None = None
+
+
+class _Replica:
+    __slots__ = ("idx", "engine", "state", "pending", "consec_respawns",
+                 "respawns")
+
+    def __init__(self, idx, engine):
+        self.idx = idx
+        self.engine = engine
+        self.state = "serving"   # serving | draining | respawning | failed
+        self.pending = 0         # in-flight requests routed here
+        self.consec_respawns = 0
+        self.respawns = 0
+
+
+class FleetRouter:
+    """N replicas, one router thread, the full SupervisedEngine surface.
+
+    ``make_replica(i) -> SupervisedEngine`` builds (and rebuilds) replica
+    ``i``; build the jitted forward ONCE outside and close over it so all
+    replicas share one warm jit cache — then warmup compiles each rung
+    once for the whole fleet, and neither restarts, respawns, nor weight
+    reloads ever recompile. Duck-types the engine surface every consumer
+    uses (submit / evaluate / warmup / stats / compile_cache_size /
+    health / close / context manager), so selfplay, arena agents, the
+    shared registry, and /healthz adapters ride it unchanged.
+    """
+
+    def __init__(self, make_replica, replicas: int,
+                 config: FleetConfig | None = None, name: str = "fleet",
+                 metrics=None, clock=time.monotonic, sleep=time.sleep,
+                 rng=None):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.config = config or FleetConfig()
+        if self.config.default_tier not in TIERS:
+            raise ValueError(
+                f"default_tier {self.config.default_tier!r} not in {TIERS}")
+        self.name = name
+        self._make_replica = make_replica
+        self._metrics = metrics
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = rng if rng is not None else random.Random()
+        self._lock = threading.Lock()
+        self._closing = threading.Event()
+        self._events: queue.Queue = queue.Queue()
+        self._rr = 0                       # round-robin tie-break cursor
+        self._current_params = None        # set by reload; respawns converge
+        self._reload_mutex = threading.Lock()
+        self._failovers = 0
+        self._respawns = 0
+        self._reloads = 0
+        self._poisoned = 0
+        self._shed = {t: 0 for t in TIERS}
+        self._tier_lat: dict[str, deque] = {t: deque(maxlen=4096)
+                                            for t in TIERS}
+        self._failover_lat: deque = deque(maxlen=1024)
+        reg = get_registry()
+        self._obs_failovers = reg.counter(
+            "deepgo_fleet_failovers_total",
+            "requests re-routed off a failed replica")
+        self._obs_shed = reg.counter(
+            "deepgo_fleet_shed_total",
+            "requests shed at the fleet door (tier, reason)")
+        self._obs_respawns = reg.counter(
+            "deepgo_fleet_respawns_total",
+            "replicas rebuilt in the background after supervisor give-up")
+        self._obs_reloads = reg.counter(
+            "deepgo_fleet_reloads_total", "rolling weight reloads completed")
+        self._obs_serving = reg.gauge(
+            "deepgo_fleet_replicas_serving",
+            "replicas currently accepting placement")
+        self._obs_failover_s = reg.histogram(
+            "deepgo_fleet_failover_seconds",
+            "first replica failure to final resolution, failed-over "
+            "requests only")
+        # the EXISTING request histogram gains a tier label at fleet
+        # level: per-tier latency scrapes next to the engines' own series
+        self._obs_request = reg.histogram(
+            "deepgo_serving_request_seconds",
+            "request latency submit-to-result")
+        self._replicas = [_Replica(i, make_replica(i))
+                          for i in range(replicas)]
+        self._update_serving_gauge()
+        self._thread = threading.Thread(
+            target=self._router_loop, name=f"fleet-{name}", daemon=True)
+        self._thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def warmup(self) -> int:
+        """Warm every replica; with a shared jitted forward the first
+        replica compiles the ladder and the rest hit the cache. Returns
+        the per-replica rung count (the engine warmup contract)."""
+        warmed = 0
+        for rep in self._replicas:
+            warmed = rep.engine.warmup()
+        return warmed
+
+    def compile_cache_size(self) -> int | None:
+        return self._replicas[0].engine.compile_cache_size()
+
+    @property
+    def ladder(self):
+        return self._replicas[0].engine.ladder
+
+    @property
+    def replicas(self) -> int:
+        return len(self._replicas)
+
+    def _check_alive(self) -> None:
+        if self._closing.is_set():
+            raise EngineClosed(f"FleetRouter[{self.name}] is closed")
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop routing and shut every replica down. Same contract as the
+        layers below: returns with every outstanding future resolved —
+        drained results or typed EngineClosed, never stranded waiters."""
+        self._closing.set()
+        self._events.put(("stop", None))
+        self._thread.join(timeout=timeout)
+        for rep in self._replicas:
+            try:
+                rep.engine.close(drain=drain, timeout=timeout)
+            except Exception:  # pragma: no cover — corpse cleanup only
+                pass
+        exc = EngineClosed(
+            f"FleetRouter[{self.name}] closed with request pending")
+        while True:
+            try:
+                kind, payload = self._events.get_nowait()
+            except queue.Empty:
+                break
+            if kind == "failover" and not payload.future.done():
+                payload.future.set_exception(exc)
+        if self._metrics is not None:
+            self._metrics.write("fleet_close", fleet=self.name,
+                                **self._counters())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, packed: np.ndarray, player: int, rank: int,
+               tier: str | None = None, timeout_s: float | None = None,
+               block: bool = True) -> Future:
+        """Queue one board on the least-loaded replica; the Future ALWAYS
+        resolves: the result row (possibly after transparent failovers,
+        replica restarts, and background respawns), TimeoutError,
+        EngineOverloaded (tier shed at the fleet door), CircuitOpen /
+        EngineBusy (every replica shedding), PoisonedRequest (the request
+        itself fails the forward — final, never retried fleet-wide),
+        FailoverExhausted, or FleetUnavailable."""
+        self._check_alive()
+        tier = tier or self.config.default_tier
+        if tier not in TIERS:
+            raise ValueError(f"tier {tier!r} not in {TIERS}")
+        if timeout_s is not None and self.config.admission_control:
+            est = self.estimated_wait_s()
+            if est is not None and est > timeout_s * self.config.headroom(tier):
+                self._count_shed(tier, "admission")
+                raise EngineOverloaded(
+                    f"FleetRouter[{self.name}] estimated queue wait "
+                    f"{est:.3f}s exceeds tier {tier!r} headroom "
+                    f"({self.config.headroom(tier):g} x {timeout_s}s "
+                    "deadline); shed at the fleet door")
+        now = self._clock()
+        deadline = None if timeout_s is None else now + timeout_s
+        req = _FleetRequest(np.asarray(packed), int(player), int(rank),
+                            tier, deadline, now)
+        self._dispatch(req, block=block)
+        if req.future.done():
+            exc = req.future.exception()
+            if isinstance(exc, (EngineOverloaded, CircuitOpen, EngineBusy,
+                                FleetUnavailable)):
+                raise exc  # door-shed surface, same as SupervisedEngine
+        return req.future
+
+    def evaluate(self, packed: np.ndarray, players: np.ndarray,
+                 ranks: np.ndarray, timeout_s: float | None = None,
+                 tier: str | None = None) -> np.ndarray:
+        """Blocking convenience, same shape as InferenceEngine.evaluate."""
+        futures = [self.submit(packed[i], int(players[i]), int(ranks[i]),
+                               tier=tier, timeout_s=timeout_s)
+                   for i in range(len(packed))]
+        return np.stack([f.result() for f in futures])
+
+    def estimated_wait_s(self) -> float | None:
+        """The fleet's load estimate: the MINIMUM replica estimate — a
+        new request goes to the least-loaded replica, so the best replica
+        is the wait the request will actually see. None when no serving
+        replica has dispatch data yet (an idle fleet never sheds)."""
+        with self._lock:
+            reps = [r for r in self._replicas if r.state == "serving"]
+        vals = []
+        for r in reps:
+            try:
+                v = r.engine.estimated_wait_s()
+            except Exception:  # a dying replica must not poison admission
+                continue
+            vals.append(0.0 if v is None else v)
+        return min(vals) if vals else None
+
+    # -- routing -----------------------------------------------------------
+
+    def _pick(self, req: _FleetRequest, tried: set[int]):
+        """Least-estimated-wait placement over serving replicas, skipping
+        this request's exclusions. Draining replicas (mid-reload) are a
+        last resort: better one more old-weights request than a shed."""
+        avoid = req.excluded | tried
+        with self._lock:
+            cands = [r for r in self._replicas
+                     if r.state == "serving" and r.idx not in avoid]
+            if not cands:
+                cands = [r for r in self._replicas
+                         if r.state == "draining" and r.idx not in avoid]
+            self._rr += 1
+            rr = self._rr
+        if not cands:
+            return None
+        n = len(self._replicas)
+
+        def key(r):
+            try:
+                est = r.engine.estimated_wait_s()
+            except Exception:
+                est = None
+            return (est if est is not None else 0.0, r.pending,
+                    (r.idx - rr) % n)
+
+        return min(cands, key=key)
+
+    def _dispatch(self, req: _FleetRequest, block: bool = True) -> None:
+        """Route one request: try candidates best-first until a replica
+        accepts it. Hard failures exclude the replica for this request's
+        lifetime (failover-with-exclusion) and wake the respawn scanner;
+        sheds only skip the replica for this routing round."""
+        tried: set[int] = set()
+        shed_error: BaseException | None = None
+        while True:
+            if req.future.done():
+                return
+            if req.deadline is not None and self._clock() >= req.deadline:
+                req.future.set_exception(TimeoutError(
+                    f"request deadline expired before placement in "
+                    f"FleetRouter[{self.name}]"))
+                return
+            rep = self._pick(req, tried)
+            if rep is None:
+                self._resolve_unroutable(req, shed_error)
+                return
+            remaining = (None if req.deadline is None
+                         else req.deadline - self._clock())
+            try:
+                faults.check("fleet_route")
+                inner = rep.engine.submit(req.packed, req.player, req.rank,
+                                          timeout_s=remaining, block=block)
+            except (EngineOverloaded, CircuitOpen, EngineBusy) as e:
+                # replica-level shed: transparent reroute, no exclusion —
+                # the replica is healthy, just full (or probing)
+                tried.add(rep.idx)
+                shed_error = e
+                continue
+            except (EngineError, faults.FaultError) as e:
+                # replica dead/dying under the submit (RestartsExhausted,
+                # EngineClosed, injected route fault): exclude + re-route
+                self._note_failure(req, rep, e)
+                if req.future.done():
+                    return
+                continue
+            with self._lock:
+                rep.pending += 1
+            inner.add_done_callback(
+                lambda f, rep=rep: self._on_replica_done(req, rep, f))
+            return
+
+    def _resolve_unroutable(self, req: _FleetRequest,
+                            shed_error: BaseException | None) -> None:
+        """Every candidate is gone: a shed if replicas shed us, typed
+        exhaustion if this request already fled failures, else the fleet
+        is simply down."""
+        if shed_error is not None:
+            self._count_shed(req.tier, "replicas")
+            req.future.set_exception(shed_error)
+        elif req.failovers > 0:
+            err = FailoverExhausted(
+                f"FleetRouter[{self.name}] request failed over "
+                f"{req.failovers} time(s) and no healthy replica remains")
+            err.__cause__ = req.last_error
+            req.future.set_exception(err)
+        else:
+            self._count_shed(req.tier, "unroutable")
+            req.future.set_exception(FleetUnavailable(
+                f"FleetRouter[{self.name}] has no serving replica "
+                f"({self._serving_count()}/{len(self._replicas)} serving)"))
+
+    def _note_failure(self, req: _FleetRequest, rep: _Replica,
+                      exc: BaseException) -> None:
+        """Account one replica failure against the request's bounded
+        failover budget and schedule the replica health check."""
+        req.excluded.add(rep.idx)
+        req.last_error = exc
+        req.failovers += 1
+        if req.t_first_failure is None:
+            req.t_first_failure = self._clock()
+        with self._lock:
+            self._failovers += 1
+        self._obs_failovers.inc(fleet=self.name)
+        self._events.put(("check", rep))
+        if req.failovers > self.config.max_failovers:
+            err = FailoverExhausted(
+                f"FleetRouter[{self.name}] request exhausted its failover "
+                f"budget ({self.config.max_failovers}); last error: {exc!r}")
+            err.__cause__ = exc
+            req.future.set_exception(err)
+
+    def _on_replica_done(self, req: _FleetRequest, rep: _Replica,
+                         f: Future) -> None:
+        """Classify one replica completion. Runs on whatever thread
+        resolved the replica future — never blocks, never submits;
+        failovers are handed to the router thread."""
+        with self._lock:
+            rep.pending -= 1
+        exc = f.exception()
+        if req.future.done():
+            return
+        if exc is None:
+            rep.consec_respawns = 0
+            dt = self._clock() - req.t_submit
+            self._obs_request.observe(dt, engine=self.name, tier=req.tier)
+            with self._lock:
+                self._tier_lat[req.tier].append(dt)
+            if req.t_first_failure is not None:
+                lat = self._clock() - req.t_first_failure
+                self._obs_failover_s.observe(lat, fleet=self.name)
+                with self._lock:
+                    self._failover_lat.append(lat)
+            req.future.set_result(f.result())
+        elif isinstance(exc, TimeoutError):
+            # the deadline is the request's own: final wherever it expired
+            req.future.set_exception(exc)
+        elif isinstance(exc, PoisonedRequest):
+            # the request's content fails the forward — retrying it on
+            # another replica would just poison the whole fleet in turn
+            with self._lock:
+                self._poisoned += 1
+            req.future.set_exception(exc)
+        else:
+            # replica died under the request (RestartsExhausted, closed,
+            # or an unclassified engine error): failover with exclusion
+            self._note_failure(req, rep, exc)
+            if not req.future.done():
+                self._events.put(("failover", req))
+
+    # -- the router thread -------------------------------------------------
+
+    def _router_loop(self) -> None:
+        ticks = 0
+        while True:
+            try:
+                kind, payload = self._events.get(timeout=0.05)
+            except queue.Empty:
+                if self._closing.is_set():
+                    return
+                ticks += 1
+                if ticks % 5 == 0:  # idle backstop: catch silent deaths
+                    self._scan_replicas()
+                continue
+            if kind == "stop":
+                return
+            if kind == "failover":
+                self._dispatch(payload, block=True)
+            elif kind == "check":
+                self._check_replica(payload)
+
+    def _scan_replicas(self) -> None:
+        for rep in self._replicas:
+            self._check_replica(rep)
+
+    def _check_replica(self, rep: _Replica) -> None:
+        with self._lock:
+            if rep.state != "serving":
+                return
+        try:
+            state = rep.engine.health().get("state")
+        except Exception:
+            state = "failed"
+        if state in ("failed", "closed") and not self._closing.is_set():
+            with self._lock:
+                if rep.state != "serving":
+                    return
+                rep.state = "respawning"
+            self._update_serving_gauge()
+            threading.Thread(target=self._respawn, args=(rep,),
+                             name=f"fleet-{self.name}-respawn-{rep.idx}",
+                             daemon=True).start()
+
+    def _respawn(self, rep: _Replica) -> None:
+        """Background rebuild of one dead replica: bounded consecutive
+        attempts with full-jitter backoff; the fleet keeps serving on the
+        survivors the whole time."""
+        flight_dump("fleet_respawn", fleet=self.name, replica=rep.idx,
+                    consec=rep.consec_respawns + 1)
+        while not self._closing.is_set():
+            rep.consec_respawns += 1
+            if rep.consec_respawns > self.config.max_respawns:
+                with self._lock:
+                    rep.state = "failed"
+                self._update_serving_gauge()
+                if self._metrics is not None:
+                    self._metrics.write(
+                        "fleet_replica_failed", fleet=self.name,
+                        replica=rep.idx, respawns=rep.respawns)
+                return
+            self._sleep(full_jitter_delay(
+                rep.consec_respawns - 1, self.config.respawn_base_s,
+                self.config.respawn_cap_s, self._rng))
+            try:
+                rep.engine.close(drain=False, timeout=1.0)
+            except Exception:  # pragma: no cover — corpse cleanup only
+                pass
+            try:
+                eng = self._make_replica(rep.idx)
+                if self._current_params is not None:
+                    eng.set_params(self._current_params)
+                if self.config.warm_on_respawn:
+                    eng.warmup()
+            except Exception:
+                continue  # burns one consecutive-respawn budget slot
+            if self._closing.is_set():
+                try:
+                    eng.close(drain=False, timeout=1.0)
+                except Exception:
+                    pass
+                return
+            with self._lock:
+                rep.engine = eng
+                rep.state = "serving"
+                rep.respawns += 1
+                self._respawns += 1
+                total = self._respawns
+            self._update_serving_gauge()
+            self._obs_respawns.inc(fleet=self.name)
+            if self._metrics is not None:
+                self._metrics.write("fleet_respawn", fleet=self.name,
+                                    replica=rep.idx,
+                                    attempt=rep.consec_respawns,
+                                    total_respawns=total)
+            return
+
+    # -- hot weight reload -------------------------------------------------
+
+    def reload(self, new_params, drain_timeout_s: float | None = None
+               ) -> dict:
+        """Roll new weights through the fleet, one replica at a time.
+
+        ``new_params`` is a params pytree matching the serving model's
+        structure/shapes (the bucket ladder and jit cache stay warm — the
+        swap never recompiles), or a checkpoint path loaded against the
+        current params as template. Protocol per replica: drain
+        (placement skips it; in-flight requests finish on the weights
+        they were submitted under), pointer-swap, rejoin — so in-flight
+        futures never drop and capacity never dips below N-1. Replicas
+        mid-respawn are skipped: the respawn itself applies the new
+        weights, as does every later supervisor restart (the
+        ``set_params`` override). Returns ``{"replicas": swapped,
+        "seconds": wall}``. Concurrent reloads serialize."""
+        self._check_alive()
+        with self._reload_mutex:
+            params = self._resolve_params(new_params)
+            t0 = self._clock()
+            # from this instant every respawn/rebuild converges on the
+            # new weights, even for replicas the roll hasn't reached yet
+            self._current_params = params
+            budget = (self.config.drain_timeout_s
+                      if drain_timeout_s is None else drain_timeout_s)
+            swapped = 0
+            for rep in self._replicas:
+                if self._closing.is_set():
+                    raise EngineClosed(
+                        f"FleetRouter[{self.name}] closed mid-reload "
+                        f"({swapped} replica(s) already swapped)")
+                with self._lock:
+                    if rep.state != "serving":
+                        continue  # respawn path applies the new weights
+                    rep.state = "draining"
+                self._update_serving_gauge()
+                try:
+                    deadline = self._clock() + budget
+                    while (rep.pending > 0 and self._clock() < deadline
+                           and not self._closing.is_set()):
+                        self._sleep(0.002)
+                    try:
+                        faults.check("fleet_reload")
+                    except faults.FaultError as e:
+                        raise FleetReloadError(
+                            f"FleetRouter[{self.name}] reload failed at "
+                            f"replica {rep.idx} ({swapped} already "
+                            "swapped; restarts/respawns will converge on "
+                            "the new weights)") from e
+                    rep.engine.set_params(params)
+                    swapped += 1
+                finally:
+                    with self._lock:
+                        if rep.state == "draining":
+                            rep.state = "serving"
+                    self._update_serving_gauge()
+            dt = self._clock() - t0
+            with self._lock:
+                self._reloads += 1
+            self._obs_reloads.inc(fleet=self.name)
+            if self._metrics is not None:
+                self._metrics.write("fleet_reload", fleet=self.name,
+                                    replicas=swapped,
+                                    seconds=round(dt, 4))
+            return {"replicas": swapped, "seconds": dt}
+
+    def _resolve_params(self, new):
+        if isinstance(new, (str, os.PathLike)):
+            from ..experiments import checkpoint as ckpt
+
+            path = str(new)
+            template = self._current_params
+            if template is None:
+                template = self._replicas[0].engine.params
+            _, p_leaves, _ = ckpt.load_checkpoint(path)
+            return ckpt.unflatten_like(template, p_leaves, path)
+        return new
+
+    # -- observability -----------------------------------------------------
+
+    def _serving_count(self) -> int:
+        with self._lock:
+            return sum(r.state == "serving" for r in self._replicas)
+
+    def _update_serving_gauge(self) -> None:
+        self._obs_serving.set(self._serving_count(), fleet=self.name)
+
+    def _count_shed(self, tier: str, reason: str) -> None:
+        with self._lock:
+            self._shed[tier] += 1
+        self._obs_shed.inc(fleet=self.name, tier=tier, reason=reason)
+
+    def _counters(self) -> dict:
+        with self._lock:
+            return {
+                "failovers": self._failovers,
+                "respawns": self._respawns,
+                "reloads": self._reloads,
+                "poisoned": self._poisoned,
+                "shed": dict(self._shed),
+            }
+
+    def _tier_latency(self) -> dict:
+        out = {}
+        with self._lock:
+            snap = {t: list(lat) for t, lat in self._tier_lat.items()}
+        for tier, lat in snap.items():
+            arr = np.array(lat, dtype=np.float64)
+            out[tier] = {
+                "requests": int(arr.size),
+                "p50_ms": round(float(np.percentile(arr, 50)) * 1000, 3)
+                if arr.size else None,
+                "p99_ms": round(float(np.percentile(arr, 99)) * 1000, 3)
+                if arr.size else None,
+            }
+        return out
+
+    def health(self) -> dict:
+        """One snapshot of the whole fleet. ``state`` is strict on
+        purpose: "serving" only at FULL strength, "degraded" while any
+        replica is down-but-covered, "down" below ``min_serving`` — so a
+        composed /healthz (health_from_engine) flips 503 the moment a
+        replica dies and back to 200 when the respawn lands, and an
+        orchestrator watching the endpoint sees the incident even though
+        the fleet absorbed it."""
+        with self._lock:
+            reps = list(self._replicas)
+        detail = []
+        serving = 0
+        for r in reps:
+            entry = {"replica": r.idx, "state": r.state,
+                     "pending": r.pending, "respawns": r.respawns}
+            if r.state in ("serving", "draining"):
+                try:
+                    h = r.engine.health()
+                except Exception as e:  # noqa: BLE001 — reported inline
+                    entry["error"] = repr(e)
+                    h = {"state": "failed"}
+                entry["engine_state"] = h.get("state")
+                entry["breaker"] = (h.get("breaker") or {}).get("state")
+                entry["estimated_wait_s"] = h.get("estimated_wait_s")
+                # a DRAINING replica still counts as healthy: a planned
+                # sub-second reload drain is not an incident, and /healthz
+                # must flip 503 for deaths, not for rolling upgrades
+                if h.get("state") == "serving":
+                    serving += 1
+            detail.append(entry)
+        if self._closing.is_set():
+            state = "closed"
+        elif serving == len(reps):
+            state = "serving"
+        elif serving >= self.config.min_serving:
+            state = "degraded"
+        else:
+            state = "down"
+        out = {"state": state, "replicas_serving": serving,
+               "replicas_total": len(reps),
+               "estimated_wait_s": self.estimated_wait_s(),
+               "tiers": self._tier_latency(), "replicas": detail}
+        out.update(self._counters())
+        return out
+
+    def stats(self) -> dict:
+        """Per-replica engine stats plus a ``fleet`` block — existing
+        consumers (selfplay's stats["engine"], bench) surface the fleet
+        counters without a second call site."""
+        with self._lock:
+            reps = list(self._replicas)
+        replica_stats = []
+        boards = 0
+        for r in reps:
+            try:
+                s = r.engine.stats()
+            except Exception as e:  # noqa: BLE001 — a corpse mid-respawn
+                s = {"error": repr(e)}
+            s["replica"] = r.idx
+            s["state"] = r.state
+            boards += s.get("boards") or 0
+            replica_stats.append(s)
+        with self._lock:
+            failover_lat = list(self._failover_lat)
+        fleet = self._counters()
+        fleet.update({
+            "replicas_serving": self._serving_count(),
+            "replicas_total": len(reps),
+            "boards": boards,
+            "tiers": self._tier_latency(),
+            "failover_p50_ms": round(float(np.percentile(
+                np.array(failover_lat), 50)) * 1000, 3)
+            if failover_lat else None,
+        })
+        return {"fleet": fleet, "replicas": replica_stats,
+                "boards": boards}

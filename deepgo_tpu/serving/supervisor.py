@@ -144,6 +144,7 @@ class SupervisedEngine:
         self._quarantined: list[str] = []
         self._closing = threading.Event()
         self._failed: EngineError | None = None
+        self._params_override = None      # set_params survives restarts
         # resilience aggregates on the process registry: the counters
         # /metrics serves live and health() already snapshots. Breaker
         # state renders as a gauge (0 closed / 1 half-open / 2 open) so
@@ -177,6 +178,21 @@ class SupervisedEngine:
 
     def compile_cache_size(self) -> int | None:
         return self._engine.compile_cache_size()
+
+    @property
+    def params(self):
+        """The weights the CURRENT inner engine dispatches with."""
+        return self._engine.params
+
+    def set_params(self, params) -> None:
+        """Hot-swap weights through the supervision layer.
+
+        Forwards the pointer swap to the live inner engine AND pins the
+        override for every future restart: the factory closure was built
+        over the original weights, so without the override a post-reload
+        dispatcher death would silently resurrect the old checkpoint."""
+        self._params_override = params
+        self._engine.set_params(params)
 
     @property
     def ladder(self):
@@ -467,6 +483,8 @@ class SupervisedEngine:
                 self._flush_replay()
                 return
             self._engine = self._factory()
+            if self._params_override is not None:
+                self._engine.set_params(self._params_override)
             if self.config.warm_on_restart:
                 self._engine.warmup()
         # stale death notice (engine already replaced) still flushes: late

@@ -33,6 +33,13 @@ programmatically / via ``ExperimentConfig.faults``) and consulted at named
                    transient write faults are retried, hard ones logged and
                    absorbed (the peers' miss budget exists precisely to
                    tolerate missed beats)
+  fleet_route      inside each FleetRouter placement attempt
+                   (serving/fleet.py) — an injected fault is absorbed like
+                   a replica failure: the candidate is excluded, the
+                   request re-routes, the failover counter ticks
+  fleet_reload     once per replica swap during a rolling weight reload —
+                   a fault surfaces as a typed FleetReloadError while the
+                   draining replica rejoins and the fleet keeps serving
 
 Grammar (comma-separated ``site:kind@arg`` specs):
 

@@ -1,0 +1,535 @@
+"""Fleet router over supervised replicas (serving/fleet.py).
+
+The load-bearing contracts:
+
+  * placement resolves every submit with rows bit-identical to the
+    direct forward, spreading load over the replicas;
+  * a replica death mid-request fails over WITH EXCLUSION to a healthy
+    replica (bounded budget, typed exhaustion) while the fleet respawns
+    the corpse in the background and /health degrades then recovers;
+  * poison is final — a request whose own content fails the forward is
+    never retried fleet-wide;
+  * tiered admission sheds the cheap tier first (batch before selfplay
+    before interactive), with per-tier counters;
+  * ``reload`` rolls new weights through the replicas one at a time:
+    results bitwise-identical to a fresh engine on the new weights,
+    futures submitted mid-reload all resolve, zero recompiles (jit-cache
+    counter), and an injected ``fleet_reload`` fault is typed while the
+    replica rejoins;
+  * every submitted future RESOLVES — result or typed error — through
+    deaths, reloads, and close().
+"""
+
+import os
+import random
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepgo_tpu.models import ModelConfig, init
+from deepgo_tpu.models.serving import make_log_prob_fn
+from deepgo_tpu.serving import (TIERS, CircuitOpen, EngineBusy,
+                                EngineClosed, EngineConfig,
+                                EngineOverloaded, FailoverExhausted,
+                                FleetConfig, FleetReloadError, FleetRouter,
+                                FleetUnavailable, InferenceEngine,
+                                PoisonedRequest, SupervisedEngine,
+                                SupervisorConfig, fleet_policy_engine)
+from deepgo_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def tiny():
+    cfg = ModelConfig(num_layers=2, channels=8)
+    return cfg, init(jax.random.key(0), cfg)
+
+
+def boards(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 3, size=(n, 9, 19, 19), dtype=np.uint8),
+            rng.integers(1, 3, size=n).astype(np.int32),
+            rng.integers(1, 10, size=n).astype(np.int32))
+
+
+POISON_BOARD = np.full((9, 19, 19), 255, dtype=np.uint8)
+
+
+def ok_forward(params, packed, player, rank):
+    return np.asarray(packed, np.float32).sum(axis=(1, 2, 3)) \
+        + 1000.0 * np.asarray(player, np.float32)
+
+
+def marker_forward(params, packed, player, rank):
+    if (packed == 255).all(axis=(1, 2, 3)).any():
+        raise ValueError("poison row in batch")
+    return ok_forward(params, packed, player, rank)
+
+
+ECFG = EngineConfig(buckets=(1, 4), max_wait_ms=0.0)
+# chaos replicas: no supervisor-level restarts, so a dispatcher death
+# becomes a replica death and exercises the FLEET failure domain
+DIE_FAST = SupervisorConfig(max_restarts=0, backoff_base_s=0.001,
+                            backoff_cap_s=0.005)
+FAST_FLEET = FleetConfig(respawn_base_s=0.001, respawn_cap_s=0.005)
+
+
+def make_fleet(forward=ok_forward, replicas=2, fleet_config=FAST_FLEET,
+               sup_config=None, engine_config=ECFG, **kw):
+    def make_replica(i):
+        return SupervisedEngine(
+            lambda: InferenceEngine(forward, None, engine_config,
+                                    name=f"rep{i}"),
+            config=sup_config, name=f"rep{i}")
+
+    kw.setdefault("rng", random.Random(0))
+    return FleetRouter(make_replica, replicas, config=fleet_config,
+                       name=kw.pop("name", "test-fleet"), **kw)
+
+
+def wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+class FakeReplica:
+    """Duck-typed replica with scripted behavior, for deterministic
+    placement / shed / failover tests without threads or wall time."""
+
+    def __init__(self, idx, est=None, submit_error=None):
+        self.idx = idx
+        self.est = est
+        self.submit_error = submit_error
+        self.submitted = 0
+
+    def submit(self, packed, player, rank, timeout_s=None, block=True):
+        if self.submit_error is not None:
+            raise self.submit_error
+        self.submitted += 1
+        f = Future()
+        f.set_result(np.float32(self.idx))
+        return f
+
+    def estimated_wait_s(self):
+        return self.est
+
+    def health(self):
+        return {"state": "serving", "estimated_wait_s": self.est,
+                "breaker": {"state": "closed"}}
+
+    def stats(self):
+        return {"boards": self.submitted}
+
+    def warmup(self):
+        return 0
+
+    def compile_cache_size(self):
+        return None
+
+    def set_params(self, params):
+        pass
+
+    @property
+    def params(self):
+        return None
+
+    def close(self, drain=True, timeout=1.0):
+        pass
+
+
+def fake_fleet(reps, fleet_config=None, **kw):
+    return FleetRouter(lambda i: reps[i], len(reps),
+                       config=fleet_config, name=kw.pop("name", "fakes"),
+                       **kw)
+
+
+class TestRouting:
+    def test_submits_resolve_bitwise_and_spread(self):
+        fleet = make_fleet(replicas=3)
+        try:
+            packed, players, ranks = boards(24, seed=1)
+            futs = [fleet.submit(packed[i], int(players[i]), int(ranks[i]))
+                    for i in range(24)]
+            got = np.stack([np.atleast_1d(f.result(timeout=10))[0]
+                            for f in futs])
+            exp = ok_forward(None, packed, players, ranks)
+            assert np.array_equal(got, exp)
+            used = [s.get("boards", 0) for s in fleet.stats()["replicas"]]
+            assert sum(b > 0 for b in used) >= 2, \
+                f"placement never spread: {used}"
+        finally:
+            fleet.close()
+
+    def test_least_wait_placement_prefers_idle_replica(self):
+        busy = FakeReplica(0, est=5.0)
+        idle = FakeReplica(1, est=0.01)
+        fleet = fake_fleet([busy, idle])
+        try:
+            for _ in range(4):
+                fleet.submit(np.zeros((9, 19, 19), np.uint8), 1, 5) \
+                     .result(timeout=5)
+            assert idle.submitted == 4 and busy.submitted == 0
+        finally:
+            fleet.close()
+
+    def test_invalid_tier_rejected(self):
+        fleet = fake_fleet([FakeReplica(0)])
+        try:
+            with pytest.raises(ValueError, match="tier"):
+                fleet.submit(np.zeros((9, 19, 19), np.uint8), 1, 5,
+                             tier="platinum")
+        finally:
+            fleet.close()
+
+    def test_evaluate_matches_direct(self):
+        fleet = make_fleet(replicas=2)
+        try:
+            packed, players, ranks = boards(6, seed=3)
+            got = fleet.evaluate(packed, players, ranks)
+            exp = ok_forward(None, packed, players, ranks)
+            assert np.array_equal(np.asarray(got).ravel(), exp.ravel())
+        finally:
+            fleet.close()
+
+
+class TestTiers:
+    def test_cheap_tier_sheds_first(self):
+        # est wait 0.5s vs a 1.0s deadline: batch headroom (0.3) is
+        # exceeded, selfplay (0.6) and interactive (1.0) are not
+        fleet = fake_fleet([FakeReplica(0, est=0.5)])
+        try:
+            board = np.zeros((9, 19, 19), np.uint8)
+            with pytest.raises(EngineOverloaded):
+                fleet.submit(board, 1, 5, tier="batch", timeout_s=1.0)
+            fleet.submit(board, 1, 5, tier="selfplay",
+                         timeout_s=1.0).result(timeout=5)
+            fleet.submit(board, 1, 5, tier="interactive",
+                         timeout_s=1.0).result(timeout=5)
+            shed = fleet.health()["shed"]
+            assert shed == {"interactive": 0, "selfplay": 0, "batch": 1}
+        finally:
+            fleet.close()
+
+    def test_interactive_sheds_only_past_full_deadline(self):
+        fleet = fake_fleet([FakeReplica(0, est=2.0)])
+        try:
+            board = np.zeros((9, 19, 19), np.uint8)
+            with pytest.raises(EngineOverloaded):
+                fleet.submit(board, 1, 5, tier="interactive", timeout_s=1.0)
+            # no deadline -> never shed at admission
+            fleet.submit(board, 1, 5, tier="batch").result(timeout=5)
+        finally:
+            fleet.close()
+
+    def test_all_replicas_shedding_is_a_fleet_shed(self):
+        reps = [FakeReplica(0, submit_error=CircuitOpen("r0 open")),
+                FakeReplica(1, submit_error=EngineBusy("r1 full"))]
+        fleet = fake_fleet(reps)
+        try:
+            with pytest.raises((CircuitOpen, EngineBusy)):
+                fleet.submit(np.zeros((9, 19, 19), np.uint8), 1, 5,
+                             tier="batch")
+            assert fleet.health()["shed"]["batch"] == 1
+        finally:
+            fleet.close()
+
+    def test_replica_shed_reroutes_transparently(self):
+        reps = [FakeReplica(0, est=0.0,
+                            submit_error=EngineOverloaded("r0 loaded")),
+                FakeReplica(1, est=1.0)]
+        fleet = fake_fleet(reps)
+        try:
+            f = fleet.submit(np.zeros((9, 19, 19), np.uint8), 1, 5)
+            assert float(f.result(timeout=5)) == 1.0  # served by replica 1
+            assert sum(fleet.health()["shed"].values()) == 0
+        finally:
+            fleet.close()
+
+
+class TestFailover:
+    def test_replica_death_fails_over_and_respawns(self):
+        faults.install("serving_dispatch:fail@2")
+        fleet = make_fleet(replicas=2, sup_config=DIE_FAST)
+        try:
+            packed, players, ranks = boards(12, seed=2)
+            futs = [fleet.submit(packed[i], int(players[i]), int(ranks[i]))
+                    for i in range(12)]
+            got = np.stack([np.atleast_1d(f.result(timeout=20))[0]
+                            for f in futs])
+            # every future resolves bit-identically despite the death
+            assert np.array_equal(
+                got, ok_forward(None, packed, players, ranks))
+            h = fleet.health()
+            assert h["failovers"] >= 1
+            # the corpse is rebuilt in the background
+            assert wait_until(
+                lambda: fleet.health()["respawns"] >= 1
+                and fleet.health()["state"] == "serving"), fleet.health()
+        finally:
+            fleet.close()
+
+    def test_poison_is_final_not_retried_fleetwide(self):
+        fleet = make_fleet(forward=marker_forward, replicas=2)
+        try:
+            f = fleet.submit(POISON_BOARD, 1, 5)
+            with pytest.raises(PoisonedRequest):
+                f.result(timeout=20)
+            h = fleet.health()
+            assert h["poisoned"] == 1
+            assert h["failovers"] == 0, \
+                "poison must not burn the failover budget"
+            # neighbors keep being served
+            packed, players, ranks = boards(3, seed=4)
+            got = fleet.evaluate(packed, players, ranks)
+            assert np.array_equal(
+                np.asarray(got).ravel(),
+                ok_forward(None, packed, players, ranks).ravel())
+        finally:
+            fleet.close()
+
+    def test_failover_budget_bounded_and_typed(self):
+        err = EngineClosed("replica gone")
+        reps = [FakeReplica(i, submit_error=err) for i in range(3)]
+        cfg = FleetConfig(max_failovers=2)
+        fleet = fake_fleet(reps, fleet_config=cfg)
+        try:
+            with pytest.raises((FleetUnavailable, FailoverExhausted)):
+                f = fleet.submit(np.zeros((9, 19, 19), np.uint8), 1, 5)
+                raise f.exception(timeout=5)
+        finally:
+            fleet.close()
+
+    def test_fleet_route_fault_absorbed(self):
+        faults.install("fleet_route:transient@1")
+        fleet = make_fleet(replicas=2)
+        try:
+            packed, players, ranks = boards(1, seed=5)
+            f = fleet.submit(packed[0], int(players[0]), int(ranks[0]))
+            got = np.atleast_1d(f.result(timeout=10))[0]
+            assert got == ok_forward(None, packed, players, ranks)[0]
+            assert fleet.health()["failovers"] == 1
+        finally:
+            fleet.close()
+
+    def test_single_replica_death_is_down_then_unavailable(self):
+        faults.install("serving_dispatch:fail@1")
+        cfg = FleetConfig(max_respawns=0, respawn_base_s=0.001,
+                          respawn_cap_s=0.002)
+        fleet = make_fleet(replicas=1, sup_config=DIE_FAST,
+                           fleet_config=cfg)
+        try:
+            packed, players, ranks = boards(1, seed=6)
+            f = fleet.submit(packed[0], int(players[0]), int(ranks[0]))
+            with pytest.raises((FailoverExhausted, FleetUnavailable)):
+                raise f.exception(timeout=20)
+            assert wait_until(lambda: fleet.health()["state"] == "down")
+            with pytest.raises((FleetUnavailable, EngineClosed)):
+                fleet.submit(packed[0], 1, 5)
+        finally:
+            fleet.close()
+
+
+class TestReload:
+    def test_reload_parity_bitwise_with_fresh_engine(self):
+        cfg, params_a = tiny()
+        params_b = init(jax.random.key(7), cfg)
+        fleet = fleet_policy_engine(params_a, cfg, replicas=2,
+                                    config=ECFG, name="reload-fleet")
+        try:
+            assert fleet.warmup() == 2
+            warm = fleet.compile_cache_size()
+            packed, players, ranks = boards(6, seed=8)
+            out = fleet.reload(params_b)
+            assert out["replicas"] == 2
+            got = fleet.evaluate(packed, players, ranks)
+            with InferenceEngine(make_log_prob_fn(cfg), params_b,
+                                 ECFG) as fresh:
+                exp = fresh.evaluate(packed, players, ranks)
+            assert np.array_equal(np.asarray(got), np.asarray(exp)), \
+                "post-reload rows differ from a fresh engine on the " \
+                "new checkpoint"
+            assert fleet.compile_cache_size() == warm, \
+                "weight hot-swap recompiled"
+        finally:
+            fleet.close()
+
+    def test_reload_from_checkpoint_path(self, tmp_path):
+        from deepgo_tpu.experiments import checkpoint as ckpt
+
+        cfg, params_a = tiny()
+        params_b = init(jax.random.key(9), cfg)
+        path = os.path.join(tmp_path, "checkpoint.npz")
+        ckpt.save_checkpoint(path, params_b, {}, {
+            "id": "reload-test", "step": 1, "validation_history": [],
+            "config": {}, "git_sha": "none"})
+        fleet = fleet_policy_engine(params_a, cfg, replicas=2, config=ECFG,
+                                    name="ckpt-fleet")
+        try:
+            fleet.warmup()
+            packed, players, ranks = boards(4, seed=10)
+            fleet.reload(path)
+            got = fleet.evaluate(packed, players, ranks)
+            with InferenceEngine(make_log_prob_fn(cfg), params_b,
+                                 ECFG) as fresh:
+                exp = fresh.evaluate(packed, players, ranks)
+            assert np.array_equal(np.asarray(got), np.asarray(exp))
+        finally:
+            fleet.close()
+
+    def test_futures_mid_reload_all_resolve_zero_recompiles(self):
+        cfg, params_a = tiny()
+        params_b = init(jax.random.key(11), cfg)
+        fleet = fleet_policy_engine(params_a, cfg, replicas=2, config=ECFG,
+                                    name="midreload-fleet")
+        try:
+            fleet.warmup()
+            warm = fleet.compile_cache_size()
+            packed, players, ranks = boards(4, seed=12)
+            fwd = make_log_prob_fn(cfg)
+            exp_a = np.asarray(fwd(params_a, packed, players, ranks))
+            exp_b = np.asarray(fwd(params_b, packed, players, ranks))
+            results = []
+            errors = []
+            stop = threading.Event()
+
+            def submitter(i):
+                while not stop.is_set():
+                    try:
+                        row = fleet.submit(packed[i], int(players[i]),
+                                           int(ranks[i])).result(timeout=30)
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+                    results.append((i, np.asarray(row)))
+
+            threads = [threading.Thread(target=submitter, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)  # requests in flight before the roll starts
+            out = fleet.reload(params_b)
+            time.sleep(0.05)  # and after it finishes
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, f"futures dropped mid-reload: {errors[:3]}"
+            assert out["replicas"] == 2
+            assert len(results) > 0
+            # every row is bit-identical to EXACTLY the old or the new
+            # weights — never a torn or dropped result
+            for i, row in results:
+                assert (np.array_equal(row, exp_a[i])
+                        or np.array_equal(row, exp_b[i])), \
+                    f"row {i} matches neither checkpoint"
+            # requests after the roll see only the new weights
+            got = fleet.evaluate(packed, players, ranks)
+            assert np.array_equal(np.asarray(got), exp_b)
+            assert fleet.compile_cache_size() == warm, \
+                "mid-reload traffic triggered a recompile"
+        finally:
+            fleet.close()
+
+    def test_reload_fault_typed_and_replica_rejoins(self):
+        faults.install("fleet_reload:fail@1")
+        fleet = make_fleet(replicas=2)
+        try:
+            with pytest.raises(FleetReloadError):
+                fleet.reload(None)
+            assert fleet.health()["state"] == "serving", \
+                "a failed reload must leave the fleet serving"
+            packed, players, ranks = boards(2, seed=13)
+            got = fleet.evaluate(packed, players, ranks)
+            assert np.array_equal(
+                np.asarray(got).ravel(),
+                ok_forward(None, packed, players, ranks).ravel())
+            # the spec fired once; the retry completes the roll
+            assert fleet.reload(None)["replicas"] == 2
+        finally:
+            fleet.close()
+
+    def test_restart_after_reload_keeps_new_weights(self):
+        # the set_params override: a post-reload dispatcher death must
+        # not resurrect the factory's original checkpoint
+        cfg, params_a = tiny()
+        params_b = init(jax.random.key(14), cfg)
+        forward = make_log_prob_fn(cfg)
+        sup = SupervisedEngine(
+            lambda: InferenceEngine(forward, params_a, ECFG, name="swap"),
+            config=SupervisorConfig(backoff_base_s=0.0, backoff_cap_s=0.0),
+            name="swap")
+        try:
+            sup.set_params(params_b)
+            faults.install("serving_dispatch:fail@1")
+            packed, players, ranks = boards(2, seed=15)
+            got = sup.evaluate(packed, players, ranks)  # rides the restart
+            exp = np.asarray(forward(params_b, packed, players, ranks))
+            assert np.array_equal(np.asarray(got), exp)
+        finally:
+            sup.close()
+
+
+class TestHealthAndClose:
+    def test_degraded_then_recovered_health(self):
+        faults.install("serving_dispatch:fail@1")
+        fleet = make_fleet(replicas=2, sup_config=DIE_FAST,
+                           fleet_config=FleetConfig(
+                               respawn_base_s=0.05, respawn_cap_s=0.05))
+        try:
+            packed, players, ranks = boards(1, seed=16)
+            fleet.submit(packed[0], int(players[0]),
+                         int(ranks[0])).result(timeout=20)
+            # the kill landed on one replica: health dips below full
+            # strength (degraded -> 503 on a composed /healthz), then the
+            # background respawn restores "serving"
+            assert wait_until(
+                lambda: fleet.health()["respawns"] >= 1), fleet.health()
+            assert wait_until(
+                lambda: fleet.health()["state"] == "serving")
+            assert fleet.health()["replicas_serving"] == 2
+        finally:
+            fleet.close()
+
+    def test_health_snapshot_shape(self):
+        fleet = make_fleet(replicas=2)
+        try:
+            h = fleet.health()
+            assert h["state"] == "serving"
+            assert h["replicas_total"] == 2
+            assert set(h["shed"]) == set(TIERS)
+            assert len(h["replicas"]) == 2
+            assert {r["replica"] for r in h["replicas"]} == {0, 1}
+        finally:
+            fleet.close()
+
+    def test_close_then_submit_typed(self):
+        fleet = make_fleet(replicas=2)
+        fleet.close()
+        with pytest.raises(EngineClosed):
+            fleet.submit(np.zeros((9, 19, 19), np.uint8), 1, 5)
+        fleet.close()  # idempotent
+
+    def test_selfplay_rides_a_fleet(self):
+        from deepgo_tpu.selfplay import self_play
+
+        cfg, params = tiny()
+        games, stats = self_play(params, cfg, n_games=4, max_moves=10,
+                                 temperature=1.0, pass_threshold=2.6e-3,
+                                 seed=3, fleet=2)
+        assert len(games) == 4
+        assert stats["engine"]["fleet"]["replicas_total"] == 2
+        assert stats["engine"]["boards"] > 0

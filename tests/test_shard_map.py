@@ -8,14 +8,18 @@ import jax
 
 from deepgo_tpu.models import ModelConfig, init
 from deepgo_tpu.parallel import data_sharding, make_mesh, replicated_sharding
-from deepgo_tpu.parallel.shard_map_step import make_shard_map_train_step
+from deepgo_tpu.parallel.shard_map_step import (make_shard_map_train_step,
+                                                shard_map_available)
 from deepgo_tpu.training import make_train_step, sgd
 
 from test_parallel import _batch
 
-pytestmark = pytest.mark.skipif(
-    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
-)
+pytestmark = [
+    pytest.mark.skipif(len(jax.devices()) < 8,
+                       reason="needs 8 (virtual) devices"),
+    pytest.mark.skipif(not shard_map_available(),
+                       reason="installed jax exposes no shard_map"),
+]
 
 
 def test_shard_map_matches_spmd_path():
