@@ -2,7 +2,7 @@
 
 .PHONY: native data test test-full verify verify-faults verify-serving \
     verify-resilience verify-fleet verify-distributed verify-obs \
-    verify-slo bench bench-gate smoke clean
+    verify-slo verify-loop bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -43,7 +43,10 @@ verify-slo:  # analysis layer: SLO burn windows, sentinel gate + flight recorder
 	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py tests/test_sentinel.py \
 	    tests/test_attribution.py -q
 
-verify: verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo  # the full failure-model suite
+verify-loop:  # expert-iteration loop: replay-buffer durability, cursor-pinned bit-exact learner resume (SIGKILL included), gatekeeper, one full in-process loop turn
+	JAX_PLATFORMS=cpu python -m pytest tests/test_loop.py -q
+
+verify: verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-loop  # the full failure-model suite
 
 bench:
 	python bench.py

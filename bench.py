@@ -65,6 +65,16 @@ Extra modes (round-2 verdict items 2 and 5), each also one JSON line:
                    compile / dispatch / compute / checkpoint, residual
                    called out) joined across hosts, and the human table is
                    printed to stderr (obs/attribution.py).
+  --mode loop [--faults [SPEC]]
+                   the expert-iteration loop soak (deepgo_tpu/loop): an
+                   in-process actors → buffer → learner → gatekeeper run
+                   for a fixed window count, reporting loop_games_per_hour
+                   plus windows/gates/champion-step. With --faults it is
+                   the ROADMAP-4 chaos soak: one kill per component class
+                   (actor ingest, learner mid-window, fleet replica) and
+                   the JSON measures zero lost games, an offline-verified
+                   bit-exact learner resume, and a served champion newer
+                   than the seed.
   --gate [T]       regression sentinel (any mode): compare this run's
                    value against the last-good record for the same metric
                    and device (BENCH_LAST_GOOD.json) and exit nonzero on
@@ -103,6 +113,7 @@ _METRIC_OF = {
     "large": ("large_training_samples_per_sec_per_chip", "samples/sec"),
     "serving": ("serving_engine_boards_per_sec_per_chip", "boards/sec"),
     "distributed": ("distributed_elastic_recovery_latency_s", "s"),
+    "loop": ("loop_games_per_hour", "games/hour"),
 }
 
 
@@ -558,6 +569,16 @@ DEFAULT_FLEET_FAULTS = "serving_dispatch:fail@4,fleet_route:transient@2"
 # kill-and-resume test uses)
 DEFAULT_DIST_FAULTS = "kill:step@7"
 
+# default --mode loop chaos: one kill per component class — an actor (the
+# 2nd buffer ingest raises), the learner (the 6th training step raises,
+# mid-window, forcing a cursor-pinned bit-exact resume), the gatekeeper
+# (the 1st gate raises; the service re-queues the challenger for the
+# restarted component), and a fleet replica (the 8th dispatcher pass
+# dies; replicas run max_restarts=0 so the kill crosses into the FLEET
+# domain: failover + respawn)
+DEFAULT_LOOP_FAULTS = ("loop_ingest:fail@2,train_step:fail@6,"
+                       "loop_gate:fail@1,serving_dispatch:fail@8")
+
 
 def _bench_distributed(faults_spec: str | None = None) -> dict:
     """2-host elastic training chaos run (CPU subprocesses, simulated hosts).
@@ -698,6 +719,116 @@ def _bench_distributed(faults_spec: str | None = None) -> dict:
             "final_step": summary["final_step"],
             "attribution": attribution,
         }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_loop(on_tpu: bool, faults_spec: str | None = None) -> dict:
+    """The expert-iteration loop soak (deepgo_tpu/loop, docs/loop.md).
+
+    Runs a complete in-process loop — selfplay actors over a 2-replica
+    fleet, replay-buffer ingestion, windowed continuous learning, arena
+    gates with champion hot-reload — for a fixed number of windows, and
+    reports loop throughput as games/hour. With ``faults_spec`` it is
+    the chaos soak ROADMAP item 4 calls for: the default spec kills one
+    of each component class (an actor via ``loop_ingest``, the learner
+    via ``train_step`` mid-window, a fleet replica via
+    ``serving_dispatch`` with zero replica restarts) and the JSON then
+    carries the three acceptance facts measured, not asserted:
+
+      * ``games_lost``       acked-by-actors minus durable-on-disk —
+                             must be 0 (every acked game survived);
+      * ``resume_bitexact``  every completed window's params digest
+                             re-derived OFFLINE from its start checkpoint
+                             + recorded extent equals the live digest —
+                             the killed-and-resumed window included;
+      * ``champion_newer``   the served champion's step advanced past the
+                             seed checkpoint through a real gate pass.
+
+    Gate threshold 0 on the chaos run: the soak proves plumbing under
+    fire, not Go strength (the 55% default guards production loops)."""
+    import shutil
+    import tempfile
+
+    from deepgo_tpu.experiments import ExperimentConfig
+    from deepgo_tpu.loop import (ExpertIterationLoop, LoopConfig,
+                                 read_windows, replay_window)
+
+    if faults_spec:
+        from deepgo_tpu.utils import faults as faults_mod
+
+        faults_mod.install(faults_spec)
+    windows = 3
+    cfg = LoopConfig(
+        actors=2, fleet=2, games_per_round=3, max_moves=24,
+        temperature=0.5, steps_per_window=6, min_window_positions=48,
+        segment_games=3, gate_games=4, gate_threshold=0.0,
+        windows=windows, stall_timeout_s=300.0,
+        max_component_restarts=8,
+        replica_max_restarts=0 if faults_spec else None)
+    lcfg = ExperimentConfig(name="loop-bench", num_layers=2, channels=8,
+                            batch_size=8, rate=0.05)
+    tmp = tempfile.mkdtemp(prefix="deepgo-loop-bench-")
+    try:
+        run_dir = os.path.join(tmp, "run")
+        loop = ExpertIterationLoop(run_dir, cfg, lcfg)
+        seed_step = 0
+        t0 = time.time()
+        summary = loop.run()
+        dt = time.time() - t0
+        # offline bit-exactness witness: re-derive every window's digest
+        # from its start checkpoint + recorded extent (loop/learner.py
+        # replay_window) — the window the learner kill landed in proves
+        # the cursor-pinned resume was bit-exact
+        learner_dir = os.path.join(run_dir, "learner")
+        records = read_windows(learner_dir)
+        mismatches = []
+        for rec in records:
+            digest = replay_window(learner_dir, loop.buffer, rec)
+            if digest != rec["digest"]:
+                mismatches.append(rec["window"])
+        games = summary["games_acked"]
+        lost = games - summary["games_durable"]
+        champion_step = summary.get("champion_step") or 0
+        result = {
+            "metric": _METRIC_OF["loop"][0],
+            "value": round(games / dt * 3600, 1),
+            "unit": _METRIC_OF["loop"][1],
+            "vs_baseline": None,
+            "windows": summary["windows_trained"],
+            "games_acked": games,
+            "games_durable": summary["games_durable"],
+            "games_lost": lost,
+            "gates_passed": summary["gates_passed"],
+            "gates_rejected": summary["gates_rejected"],
+            "learner_step": summary["learner_step"],
+            "champion_step": champion_step,
+            "seed_step": seed_step,
+            "champion_newer": champion_step > seed_step,
+            "resume_bitexact": not mismatches,
+            "windows_replayed": len(records),
+            "component_restarts": summary["component_restarts"],
+            "fleet_respawns": summary["fleet_respawns"],
+            "fleet_failovers": summary["fleet_failovers"],
+            "fleet_reloads": summary["fleet_reloads"],
+            "seconds": round(dt, 2),
+        }
+        if faults_spec:
+            result["faults"] = faults_spec
+        errors = []
+        if lost != 0:
+            errors.append(f"{lost} acked game(s) not durable")
+        if mismatches:
+            errors.append(f"window digests diverged: {mismatches}")
+        if summary["windows_trained"] < windows:
+            errors.append(
+                f"only {summary['windows_trained']}/{windows} windows "
+                f"trained (fatal: {summary['fatal']})")
+        if not result["champion_newer"]:
+            errors.append("served champion never advanced past the seed")
+        if errors:
+            result["error"] = "; ".join(errors)
+        return result
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -992,17 +1123,20 @@ def main() -> None:
     ap = argparse.ArgumentParser(description="deepgo_tpu benchmarks")
     ap.add_argument("--mode", default="inference",
                     choices=["inference", "train", "latency", "large",
-                             "serving", "distributed"])
+                             "serving", "distributed", "loop"])
     ap.add_argument("--faults", nargs="?", const="__default__",
                     default=None, metavar="SPEC",
-                    help="(--mode serving / distributed) chaos run: install "
-                         "this DEEPGO_FAULTS spec (serving default: "
+                    help="(--mode serving / distributed / loop) chaos run: "
+                         "install this DEEPGO_FAULTS spec (serving default: "
                          f"'{DEFAULT_CHAOS_FAULTS}'; with --fleet: "
                          f"'{DEFAULT_FLEET_FAULTS}'; distributed default: "
                          f"'{DEFAULT_DIST_FAULTS}', given to the victim "
-                         "host). Serving reports goodput + restart/shed/"
-                         "poison counters; distributed reports recovery "
-                         "latency + steps lost")
+                         f"host; loop default: '{DEFAULT_LOOP_FAULTS}' — "
+                         "one kill per loop component class). Serving "
+                         "reports goodput + restart/shed/poison counters; "
+                         "distributed reports recovery latency + steps "
+                         "lost; loop reports games lost (must be 0), "
+                         "resume bit-exactness, and champion freshness")
     ap.add_argument("--fleet", type=int, default=None, metavar="N",
                     help="(--mode serving) route the workload through a "
                          "FleetRouter of N supervised replicas with "
@@ -1025,14 +1159,17 @@ def main() -> None:
                          "noise-aware — see docs/observability.md). The "
                          "verdict rides in the JSON line as `gate`")
     args = ap.parse_args()
-    if args.faults is not None and args.mode not in ("serving", "distributed"):
-        ap.error("--faults only applies to --mode serving or distributed")
+    if args.faults is not None and args.mode not in ("serving",
+                                                     "distributed", "loop"):
+        ap.error("--faults only applies to --mode serving, distributed, "
+                 "or loop")
     if args.fleet is not None and args.mode != "serving":
         ap.error("--fleet only applies to --mode serving")
     if args.fleet is not None and args.fleet < 2:
         ap.error("--fleet needs N >= 2 (a 1-replica fleet is --faults)")
     if args.faults == "__default__":
         args.faults = (DEFAULT_DIST_FAULTS if args.mode == "distributed"
+                       else DEFAULT_LOOP_FAULTS if args.mode == "loop"
                        else DEFAULT_FLEET_FAULTS if args.fleet
                        else DEFAULT_CHAOS_FAULTS)
 
@@ -1085,6 +1222,8 @@ def main() -> None:
             result = _bench_serving(on_tpu, args.faults,
                                     exporter=obs_exporter,
                                     fleet=args.fleet)
+        elif args.mode == "loop":
+            result = _bench_loop(on_tpu, args.faults)
         else:
             fn = {"train": _bench_train, "latency": _bench_latency,
                   "large": _bench_large}[args.mode]

@@ -9,7 +9,13 @@
                                        inference rides the serving engine)
   python -m deepgo_tpu.cli serve       serving-fleet daemon: N supervised
                                        replicas behind the failover router,
-                                       live /healthz, checkpoint hot-reload
+                                       live /healthz, verified checkpoint
+                                       hot-reload
+  python -m deepgo_tpu.cli loop        always-on expert-iteration service:
+                                       selfplay actors -> replay buffer ->
+                                       continuous learner -> arena
+                                       gatekeeper, champion hot-swapped
+                                       through the fleet (docs/loop.md)
   python -m deepgo_tpu.cli obs         offline observability report: join a
                                        run's metrics/trace/elastic JSONL
                                        streams into one per-stage table
@@ -153,6 +159,27 @@ def cmd_train(args) -> None:
           f"checkpoint at {exp.save()}")
 
 
+def verified_reload(fleet, path: str) -> dict | None:
+    """Hot-reload ``path`` through the fleet ONLY if it passes the full
+    format-v2 integrity check (per-array CRC32s + whole-file digest, the
+    ``find_latest_valid`` discipline). Returns the reload report, or None
+    when the checkpoint is unverifiable — the fleet keeps serving its
+    current weights and the operator sees why. The publish side writes
+    atomically (utils.atomicio), so a rejection here means real
+    corruption or a non-atomic producer, never a mid-write race."""
+    import sys
+
+    from .experiments import checkpoint as ckpt
+
+    try:
+        ckpt.verify_checkpoint(path)
+    except ckpt.CheckpointError as e:
+        print(f"serve: NOT reloading {e.path}: {e.reason} — fleet keeps "
+              "its current weights", file=sys.stderr, flush=True)
+        return None
+    return fleet.reload(path)
+
+
 def cmd_serve(args) -> None:
     """Long-running serving daemon: a FleetRouter of N supervised policy
     replicas with live /metrics + /healthz and checkpoint hot-reload.
@@ -207,11 +234,14 @@ def cmd_serve(args) -> None:
                 mtime = os.path.getmtime(args.watch)
                 if watched_mtime is None or mtime > watched_mtime:
                     watched_mtime = mtime
-                    out = fleet.reload(args.watch)
-                    print(f"serve: hot-reloaded {args.watch} through "
-                          f"{out['replicas']} replica(s) in "
-                          f"{out['seconds']:.3f}s (zero dropped futures, "
-                          "zero recompiles)", flush=True)
+                    # verify-before-swap: a torn or corrupt publish must
+                    # never reach live replicas (docs/loop.md)
+                    out = verified_reload(fleet, args.watch)
+                    if out is not None:
+                        print(f"serve: hot-reloaded {args.watch} through "
+                              f"{out['replicas']} replica(s) in "
+                              f"{out['seconds']:.3f}s (zero dropped "
+                              "futures, zero recompiles)", flush=True)
     finally:
         health = fleet.health()
         exporter.close()
@@ -220,6 +250,61 @@ def cmd_serve(args) -> None:
               f"{health['replicas_total']} serving, "
               f"{health['respawns']} respawns, {health['reloads']} "
               "reloads)", flush=True)
+
+
+def cmd_loop(args) -> None:
+    """The always-on expert-iteration service (docs/loop.md): selfplay
+    actors → replay buffer → continuous learner → arena gatekeeper, all
+    supervised, champion hot-swapped through the serving fleet on every
+    gate pass. Supersedes the hand-sequenced tools/r5_value_loop.sh —
+    one long-running process instead of stage-by-stage shell queues, and
+    it survives kills: re-running the identical command over the same
+    --run-dir resumes bit-exactly (learner checkpoint + read cursor)."""
+    import json as _json
+
+    from .loop import ExpertIterationLoop, LoopConfig
+
+    config = LoopConfig(
+        actors=args.actors,
+        fleet=args.fleet,
+        games_per_round=args.games_per_round,
+        max_moves=args.max_moves,
+        temperature=args.temperature,
+        steps_per_window=args.window_steps,
+        min_window_positions=args.min_positions,
+        scheme=args.scheme,
+        segment_games=args.segment_games,
+        capacity_positions=args.buffer_capacity,
+        gate_games=args.gate_games,
+        gate_threshold=args.gate_threshold,
+        windows=args.windows,
+        duration_s=args.duration,
+        stall_timeout_s=args.stall_timeout,
+        max_wait_ms=args.max_wait_ms,
+        seed=args.seed,
+    )
+    overrides = parse_overrides(args.set)
+    overrides.setdefault("name", "loop-learner")
+    lcfg = ExperimentConfig(**overrides)
+    loop = ExpertIterationLoop(args.run_dir, config, lcfg,
+                               seed_checkpoint=args.checkpoint)
+    exporter = None
+    if args.obs_port is not None:
+        from .obs import health_from_engine, start_exporter
+
+        exporter = start_exporter(args.obs_port)
+        exporter.add_health("fleet", health_from_engine(loop.fleet))
+        exporter.add_health(
+            "loop", lambda: {"healthy": not loop.fatal,
+                             **{k: v for k, v in loop.summary().items()
+                                if k in ("windows_trained", "gates_passed",
+                                         "games_acked")}})
+    try:
+        summary = loop.run()
+    finally:
+        if exporter is not None:
+            exporter.close()
+    print(_json.dumps(summary, default=str))
 
 
 def cmd_obs(args) -> None:
@@ -377,6 +462,79 @@ def main(argv=None) -> None:
                    help="serve for S seconds then exit (0 = until "
                         "SIGINT/SIGTERM)")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("loop", help="always-on expert-iteration service: "
+                                    "selfplay actors → replay buffer → "
+                                    "continuous learner → arena gatekeeper, "
+                                    "champion hot-swapped through the "
+                                    "serving fleet (docs/loop.md; "
+                                    "supersedes tools/r5_value_loop.sh)")
+    p.add_argument("--run-dir", default="runs/loop",
+                   help="the loop's durable home (buffer, learner "
+                        "checkpoints + cursor, champion.npz, loop.jsonl); "
+                        "re-running over the same dir resumes after any "
+                        "kill")
+    p.add_argument("--checkpoint", metavar="PATH",
+                   help="seed champion checkpoint (default: fresh random "
+                        "init from the learner model config)")
+    p.add_argument("--actors", type=int, default=2,
+                   help="selfplay actor threads (default 2)")
+    p.add_argument("--fleet", type=int, default=2, metavar="N",
+                   help="serving-fleet replicas behind the failover "
+                        "router; actors ride the selfplay tier "
+                        "(default 2)")
+    p.add_argument("--games-per-round", type=int, default=8,
+                   help="games per actor round (a round is the actor's "
+                        "restart/replay unit)")
+    p.add_argument("--max-moves", type=int, default=120,
+                   help="selfplay and gate-match move cap")
+    p.add_argument("--temperature", type=float, default=0.25,
+                   help="actor sampling temperature (trajectory "
+                        "diversity for the corpus)")
+    p.add_argument("--window-steps", type=int, default=50,
+                   help="learner steps per training window (each window "
+                        "publishes one challenger)")
+    p.add_argument("--min-positions", type=int, default=512,
+                   help="sealed positions required before a window may "
+                        "freeze its extent")
+    p.add_argument("--scheme", default="game",
+                   choices=["game", "uniform", "winner"],
+                   help="sampling scheme over the frozen extent "
+                        "(winner = outcome-conditioned distillation)")
+    p.add_argument("--segment-games", type=int, default=16,
+                   help="games per sealed buffer segment (the index "
+                        "version granularity)")
+    p.add_argument("--buffer-capacity", type=int, default=0,
+                   metavar="POSITIONS",
+                   help="replay-buffer position bound; oldest segments "
+                        "are evicted past it, never across a live "
+                        "cursor (0 = unbounded)")
+    p.add_argument("--gate-games", type=int, default=64,
+                   help="arena games per gate (protocol pins from "
+                        "match.standard_gate; production gates want the "
+                        "1,000-game pin)")
+    p.add_argument("--gate-threshold", type=float, default=0.55,
+                   help="challenger win rate required to take the "
+                        "champion slot (default 0.55)")
+    p.add_argument("--windows", type=int, default=0,
+                   help="stop after N completed windows (0 = run "
+                        "forever)")
+    p.add_argument("--duration", type=float, default=0.0, metavar="S",
+                   help="stop after S seconds (0 = no time limit)")
+    p.add_argument("--stall-timeout", type=float, default=600.0,
+                   metavar="S",
+                   help="typed LoopStalled when no ingest/window/gate "
+                        "progress lands within S seconds")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="per-replica dispatcher coalescing window")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                   help="live /metrics + /healthz (fleet + loop "
+                        "progress) for the duration of the run")
+    p.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE",
+                   help="learner ExperimentConfig overrides (model size, "
+                        "batch_size, rate, ... — the train grammar)")
+    p.set_defaults(fn=cmd_loop)
 
     p = sub.add_parser("obs", help="offline observability report: one "
                                    "per-stage table (loader wait, "

@@ -26,6 +26,43 @@ from .go.scoring import area_score
 from .selfplay import (GameState, legal_mask, step_games, summarize_states,
                        to_sgf)
 
+# The pinned evaluation protocol every strength number in RESULTS.md is
+# quoted under ("1,000-game precision"): 1,000 games vs the oneply
+# baseline, 8 shared random opening plies per color-swapped pair, seed 29,
+# rank plane 8 (the synthetic corpus's strongest tag). One definition —
+# standard_gate() below, the arena CLI's --standard-gate, and the shell
+# queues (tools/r5_value_loop.sh vmatch) all read these — so the arena
+# gatekeeper and the historical match queues can never drift apart.
+GATE_GAMES = 1000
+GATE_OPENING_PLIES = 8
+GATE_SEED = 29
+GATE_RANK = 8
+
+
+def standard_gate(agent_a: Agent, agent_b: Agent, n_games: int = GATE_GAMES,
+                  komi: float = 7.5, max_moves: int = 450):
+    """``play_match`` under the pinned arena protocol.
+
+    Returns (games, scores, stats) with the protocol recorded in
+    ``stats["protocol"]`` and agent A's win rate surfaced as
+    ``stats["win_rate_a"]`` (the per-name key play_match emits depends on
+    the agent's name; gate consumers want a fixed key). ``n_games`` stays
+    overridable — an in-process loop turn gates on a handful of games,
+    the production gate keeps the 1,000-game pin — but the opening /
+    seed / pairing discipline is not: that is the part that makes two win
+    rates comparable."""
+    games, scores, stats = play_match(
+        agent_a, agent_b, n_games=n_games, komi=komi, max_moves=max_moves,
+        seed=GATE_SEED, opening_plies=GATE_OPENING_PLIES,
+        shared_openings=True)
+    name_a = agent_a.name
+    stats["win_rate_a"] = stats[f"{name_a}_win_rate"]
+    stats["protocol"] = {"games": n_games, "opening_plies": GATE_OPENING_PLIES,
+                         "seed": GATE_SEED, "rank": GATE_RANK,
+                         "komi": komi, "max_moves": max_moves}
+    return games, scores, stats
+
+
 def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
                komi: float = 7.5, max_moves: int = 450, seed: int = 0,
                opening_plies: int = 0, shared_openings: bool = True):
@@ -170,6 +207,14 @@ def main(argv=None) -> None:
                          "trajectories in deterministic-vs-deterministic "
                          "matches (the color-swapped rematch shares the "
                          "opening, keeping the pairing fair)")
+    ap.add_argument("--standard-gate", action="store_true",
+                    help="apply the pinned arena protocol (the RESULTS.md "
+                         "'1,000-game precision' pins shared with the "
+                         "expert-iteration gatekeeper): --b oneply, "
+                         f"--games {GATE_GAMES}, --opening-plies "
+                         f"{GATE_OPENING_PLIES}, --seed {GATE_SEED}, "
+                         f"--rank {GATE_RANK}; explicit --games/--b win "
+                         "over the defaults, the protocol pins do not")
     ap.add_argument("--sgf-out", help="directory to write scored games")
     ap.add_argument("--engine", action="store_true",
                     help="route net-backed agents through the shared "
@@ -190,6 +235,19 @@ def main(argv=None) -> None:
                          "background respawn, tiered QoS "
                          "(docs/serving.md)")
     args = ap.parse_args(argv)
+
+    if args.standard_gate:
+        # the protocol pins are not negotiable under --standard-gate (they
+        # are what makes the number comparable to every RESULTS.md rung);
+        # the opponent and game count keep their explicit overrides so a
+        # smoke run can gate 32 games against a different baseline
+        args.rank = GATE_RANK
+        args.seed = GATE_SEED
+        args.opening_plies = GATE_OPENING_PLIES
+        if args.b == ap.get_default("b"):
+            args.b = "oneply"
+        if args.games == ap.get_default("games"):
+            args.games = GATE_GAMES
 
     from .utils import honor_platform_env
 

@@ -40,6 +40,15 @@ programmatically / via ``ExperimentConfig.faults``) and consulted at named
   fleet_reload     once per replica swap during a rolling weight reload —
                    a fault surfaces as a typed FleetReloadError while the
                    draining replica rejoins and the fleet keeps serving
+  loop_ingest      inside ReplayBuffer.ingest_game (deepgo_tpu/loop) —
+                   transients are absorbed by the bounded-jitter retry,
+                   hard faults kill the actor BEFORE the game is acked
+                   (the loop supervisor restarts it; acked games are
+                   already durable, so none are ever lost)
+  loop_gate        at the start of ArenaGatekeeper.evaluate — a hard
+                   fault kills the gatekeeper component; the service
+                   re-queues the challenger so the restarted gatekeeper
+                   re-gates it instead of dropping the window
 
 Grammar (comma-separated ``site:kind@arg`` specs):
 

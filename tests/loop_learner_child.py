@@ -1,0 +1,75 @@
+"""Subprocess driver for the SIGKILL learner-resume test (test_loop.py).
+
+Runs a small windowed-learner schedule over an on-disk replay buffer:
+before window w the buffer is grown to a deterministic game-count target
+(synthetic games that are a pure function of their gid), then the window
+trains. With ``DEEPGO_FAULTS=kill:step@K`` in the environment the
+process is SIGKILLed mid-window — the honest preemption, no cleanup —
+and re-running the identical command auto-resumes from the learner's
+checkpoint + cursor and converges on the same final state as an
+uninterrupted run. The parent test compares ``windows.jsonl`` digests
+across the killed-and-resumed and uninterrupted directories.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from deepgo_tpu.experiments import ExperimentConfig  # noqa: E402
+from deepgo_tpu.loop import (ContinuousLearner, ReplayBuffer,  # noqa: E402
+                             read_windows)
+
+
+def synth_game(gid: int, moves: int = 10):
+    """Deterministic synthetic game records keyed on gid alone — the
+    ingestion schedule replays identically across process restarts."""
+    r = np.random.default_rng(gid + 1000)
+    packed = r.integers(0, 3, size=(moves, 9, 19, 19)).astype(np.uint8)
+    meta = np.zeros((moves, 6), np.int32)
+    meta[:, 0] = r.integers(1, 3, size=moves)
+    meta[:, 1] = r.integers(0, 19, size=moves)
+    meta[:, 2] = r.integers(0, 19, size=moves)
+    meta[:, 3] = 8
+    meta[:, 4] = 8
+    return packed, meta
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--windows", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--games-per-window", type=int, default=4)
+    args = ap.parse_args()
+
+    buffer = ReplayBuffer(os.path.join(args.dir, "buf"), segment_games=2)
+    config = ExperimentConfig(name="loop-child", num_layers=2, channels=8,
+                              batch_size=8, rate=0.05, seed=7)
+    learner = ContinuousLearner(
+        buffer, os.path.join(args.dir, "run"), config,
+        steps_per_window=args.steps, min_window_positions=8)
+    while learner.window < args.windows:
+        # grow-the-corpus-mid-run schedule, keyed on DURABLE state only:
+        # a killed-and-restarted process re-derives exactly this sequence
+        target = args.games_per_window * (learner.window + 1)
+        while buffer.total_games < target:
+            buffer.ingest_game(*synth_game(buffer.total_games))
+        learner.train_window()
+    digests = [r["digest"] for r in read_windows(os.path.join(args.dir,
+                                                              "run"))]
+    print("CHILD_DONE " + json.dumps(digests), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,516 @@
+"""Expert-iteration loop suite (deepgo_tpu/loop, docs/loop.md).
+
+Covers the four components and their composition:
+
+  * replay buffer — durable ingest (acked == survives), sealing +
+    window-versioned index, crash recovery as a pure function of the
+    directory, bounded eviction that never crosses a live cursor,
+    logical-index gathers, the loop_ingest fault site;
+  * continuous learner — deterministic windowed streams, the checkpointed
+    read cursor, and THE resume property: grow the corpus mid-run, kill
+    the learner mid-window, auto-resume, and the resumed stream is
+    bit-identical to an uninterrupted run over the same ingestion
+    schedule (in-process crash + slow subprocess SIGKILL variants);
+  * arena gatekeeper — standard_gate protocol pins, the deterministic
+    50%-self-match rejection, pass → atomic champion publish + fleet
+    reload, corrupt challengers rejected before they touch serving,
+    the loop_gate fault site;
+  * the service — one full in-process loop turn (selfplay → ingest →
+    train window → gate pass → fleet hot-reload) with zero lost games,
+    the `make verify-loop` acceptance shape.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepgo_tpu import match
+from deepgo_tpu.experiments import ExperimentConfig
+from deepgo_tpu.experiments import checkpoint as ckpt
+from deepgo_tpu.loop import (ArenaGatekeeper, ContinuousLearner,
+                             ExpertIterationLoop, GateRejected, LoopConfig,
+                             LoopStalled, ReplayBuffer, ReplayError,
+                             count_durable_games, params_digest,
+                             read_windows, replay_window)
+from deepgo_tpu.loop.replay import GAMES_DIR
+from deepgo_tpu.utils import faults
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = ExperimentConfig(name="loop-test", num_layers=2, channels=8,
+                        batch_size=8, rate=0.05, seed=3)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.install("")
+
+
+def synth_game(gid: int, moves: int = 10):
+    """Deterministic synthetic records keyed on gid alone, so two buffers
+    fed the same schedule hold byte-identical segments."""
+    r = np.random.default_rng(gid + 1000)
+    packed = r.integers(0, 3, size=(moves, 9, 19, 19)).astype(np.uint8)
+    meta = np.zeros((moves, 6), np.int32)
+    meta[:, 0] = r.integers(1, 3, size=moves)
+    meta[:, 1] = r.integers(0, 19, size=moves)
+    meta[:, 2] = r.integers(0, 19, size=moves)
+    meta[:, 3] = 8
+    meta[:, 4] = 8
+    return packed, meta
+
+
+def fill(buffer: ReplayBuffer, start: int, n: int, winner_of=None) -> None:
+    for g in range(start, start + n):
+        winner = winner_of(g) if winner_of else 1 + g % 2
+        buffer.ingest_game(*synth_game(g), winner=winner)
+
+
+def make_policy_checkpoint(path: str, seed: int = 0,
+                           step: int = 0) -> None:
+    """A loadable, verifiable policy checkpoint at TINY scale."""
+    import jax
+
+    from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.training.optimizers import OPTIMIZERS
+
+    cfg = TINY.replace(seed=seed)
+    params = policy_cnn.init(jax.random.key(seed), cfg.model_config())
+    optimizer = OPTIMIZERS[cfg.optimizer](cfg.rate, cfg.rate_decay,
+                                          cfg.momentum)
+    ckpt.save_checkpoint(path, params, optimizer.init(params), {
+        "id": f"test-{seed}", "step": step, "validation_history": [],
+        "config": cfg.to_dict()})
+
+
+# ---------------------------------------------------------------------------
+# replay buffer
+
+
+class TestReplayBuffer:
+    def test_ingest_seal_version_and_gather(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path), segment_games=2)
+        fill(buf, 0, 5)
+        # 5 games at 2/segment: two seals happened, one game still open
+        assert buf.version == 2
+        assert buf.stats()["open_games"] == 1
+        lo, hi, version = buf.extent()
+        assert (lo, version) == (0, 2)
+        view = buf.view(lo, hi)
+        assert len(view) == hi - lo
+        # gather a known game bit-exactly through its logical range
+        packed0, meta0 = synth_game(0)
+        start, count = view.game_ranges[0]
+        assert count == packed0.shape[0]
+        got_packed, player, rank, target = view.batch_at(
+            np.arange(start, start + count))
+        np.testing.assert_array_equal(got_packed, packed0)
+        np.testing.assert_array_equal(player, meta0[:, 0])
+        np.testing.assert_array_equal(rank, np.full(count, 8))
+        np.testing.assert_array_equal(
+            target, meta0[:, 1] * 19 + meta0[:, 2])
+
+    def test_reopen_recovers_sealed_and_open(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path), segment_games=2)
+        fill(buf, 0, 5)
+        stats = buf.stats()
+        buf2 = ReplayBuffer(str(tmp_path), segment_games=2)
+        assert buf2.stats() == stats
+        assert buf2.total_games == 5
+        # the open game seals after reopen, proving it truly survived
+        buf2.seal()
+        assert buf2.stats()["open_games"] == 0
+        assert buf2.extent()[1] > stats["sealed_hi"]
+
+    def test_torn_seal_and_stale_game_recovery(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path), segment_games=2)
+        fill(buf, 0, 4)
+        # debris: a half-built segment dir the index never committed, and
+        # a stale game file at a gid the watermark says is already sealed
+        os.makedirs(tmp_path / "seg-000099")
+        (tmp_path / "seg-000099" / "planes.bin").write_bytes(b"torn")
+        packed, meta = synth_game(0)
+        stale = tmp_path / GAMES_DIR / "g-00000001.npz"
+        np.savez(stale, packed=packed, meta=meta, winner=np.int32(0))
+        buf2 = ReplayBuffer(str(tmp_path), segment_games=2)
+        assert not (tmp_path / "seg-000099").exists()
+        assert not stale.exists()
+        assert buf2.total_games == 4
+        assert count_durable_games(str(tmp_path)) == 4
+
+    def test_ingest_fault_site(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path), segment_games=10)
+        faults.install("loop_ingest:fail@1")
+        with pytest.raises(faults.InjectedFailure):
+            buf.ingest_game(*synth_game(0))
+        # the failed ingest acked nothing and left nothing on disk
+        assert buf.total_games == 0
+        assert count_durable_games(str(tmp_path)) == 0
+        # the next attempt (the restarted actor's replay) lands cleanly
+        buf.ingest_game(*synth_game(0))
+        assert buf.total_games == 1
+
+    def test_ingest_transient_absorbed(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path), segment_games=10)
+        faults.install("loop_ingest:transient@2")
+        buf.ingest_game(*synth_game(0))  # retried, no error escapes
+        assert buf.total_games == 1
+
+    def test_eviction_respects_protect_lo(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path), segment_games=2,
+                           capacity_positions=30)
+        fill(buf, 0, 8)  # 4 segments x 20 positions
+        lo, hi, _ = buf.extent()
+        # a cursor pinned at the second segment blocks eviction past it
+        protect = buf._segments[1].lo
+        buf.evict(protect_lo=protect)
+        assert buf.base_lo == protect
+        # the protected extent still resolves; anything older is typed
+        buf.view(protect, hi)
+        with pytest.raises(ReplayError):
+            buf.view(lo, hi)
+
+    def test_winner_scheme_filters(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path), segment_games=4)
+        fill(buf, 0, 4, winner_of=lambda g: 1)  # black always won
+        buf.seal()
+        view = buf.view(*buf.extent()[:2])
+        cand = view.winner_positions()
+        _, player, _, _ = view.batch_at(cand)
+        assert (player == 1).all() and cand.size > 0
+        idx = view.sample_indices(np.random.default_rng(0), 16, "winner")
+        assert np.isin(idx, cand).all()
+
+    def test_rejects_malformed_games(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path))
+        with pytest.raises(ValueError):
+            buf.ingest_game(np.zeros((0, 9, 19, 19), np.uint8),
+                            np.zeros((0, 6), np.int32))
+        with pytest.raises(ValueError):
+            buf.ingest_game(np.zeros((3, 9, 19, 19), np.float32),
+                            np.zeros((3, 6), np.int32))
+
+
+# ---------------------------------------------------------------------------
+# continuous learner
+
+
+def make_learner(buf, run_dir, **kw):
+    kw.setdefault("steps_per_window", 3)
+    kw.setdefault("min_window_positions", 16)
+    return ContinuousLearner(buf, str(run_dir), TINY, **kw)
+
+
+class TestLearner:
+    def test_windows_deterministic_across_learners(self, tmp_path):
+        digests = []
+        for side in ("a", "b"):
+            buf = ReplayBuffer(str(tmp_path / f"buf-{side}"),
+                               segment_games=4)
+            fill(buf, 0, 4)
+            learner = make_learner(buf, tmp_path / f"run-{side}")
+            rec1 = learner.train_window()
+            fill(buf, 4, 4)  # the corpus grows between windows
+            rec2 = learner.train_window()
+            digests.append((rec1["digest"], rec2["digest"]))
+        assert digests[0] == digests[1]
+        assert digests[0][0] != digests[0][1]
+
+    def test_offline_replay_matches_live_digests(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path / "buf"), segment_games=4)
+        fill(buf, 0, 4)
+        learner = make_learner(buf, tmp_path / "run")
+        learner.train_window()
+        fill(buf, 4, 4)
+        learner.train_window()
+        for rec in read_windows(str(tmp_path / "run")):
+            assert replay_window(str(tmp_path / "run"), buf, rec) \
+                == rec["digest"]
+
+    def test_crash_mid_window_resumes_bit_exact_despite_growth(
+            self, tmp_path):
+        """THE resume property: corpus grows mid-run, the learner dies
+        mid-window, more games land while it is down, and the resumed
+        stream is still bit-identical to an uninterrupted run over the
+        same ingestion schedule — because the checkpointed cursor pins
+        the extent the window froze, not whatever the buffer holds at
+        resume time."""
+        # uninterrupted reference
+        buf_a = ReplayBuffer(str(tmp_path / "buf-a"), segment_games=4)
+        fill(buf_a, 0, 4)
+        ref = make_learner(buf_a, tmp_path / "run-a")
+        ref.train_window()
+        fill(buf_a, 4, 4)
+        rec_a = ref.train_window()
+        # killed-and-resumed run over the identical schedule
+        buf_b = ReplayBuffer(str(tmp_path / "buf-b"), segment_games=4)
+        fill(buf_b, 0, 4)
+        victim = make_learner(buf_b, tmp_path / "run-b")
+        victim.train_window()
+        fill(buf_b, 4, 4)
+        faults.install("train_step:fail@2")  # dies inside window 2
+        with pytest.raises(faults.InjectedFailure):
+            victim.train_window()
+        faults.install("")
+        # the corpus keeps growing while the learner is down — the part
+        # a naive "re-freeze at resume" implementation gets wrong
+        fill(buf_b, 8, 4)
+        resumed = make_learner(buf_b, tmp_path / "run-b")
+        assert resumed.resumed_from is not None
+        rec_b = resumed.train_window()
+        assert rec_b["extent"] == rec_a["extent"]
+        assert rec_b["digest"] == rec_a["digest"]
+        assert params_digest(resumed.params) == params_digest(ref.params)
+
+    def test_clean_boundary_resume_freezes_fresh_extent(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path / "buf"), segment_games=4)
+        fill(buf, 0, 4)
+        learner = make_learner(buf, tmp_path / "run")
+        rec1 = learner.train_window()
+        fill(buf, 4, 4)
+        # a kill BETWEEN windows: checkpoint and cursor agree the window
+        # completed, so the resume freezes the grown extent, exactly as
+        # the uninterrupted run would have
+        resumed = make_learner(buf, tmp_path / "run")
+        rec2 = resumed.train_window()
+        assert rec2["extent"][1] > rec1["extent"][1]
+
+    def test_publish_is_loadable_and_verified(self, tmp_path):
+        from deepgo_tpu.models.serving import load_policy
+
+        buf = ReplayBuffer(str(tmp_path / "buf"), segment_games=4)
+        fill(buf, 0, 4)
+        challenger = tmp_path / "challenger.npz"
+        learner = make_learner(buf, tmp_path / "run",
+                               publish_path=str(challenger))
+        rec = learner.train_window()
+        assert rec["published"] == str(challenger)
+        ckpt.verify_checkpoint(str(challenger))
+        _, params, _ = load_policy(str(challenger))
+        assert params_digest(params) == rec["digest"]
+
+    def test_starved_buffer_raises_typed_stall(self, tmp_path):
+        buf = ReplayBuffer(str(tmp_path / "buf"), segment_games=4)
+        fill(buf, 0, 1)
+        t = [0.0]
+
+        def clock():
+            return t[0]
+
+        def sleep(s):
+            t[0] += s
+
+        learner = ContinuousLearner(
+            buf, str(tmp_path / "run"), TINY, steps_per_window=3,
+            min_window_positions=10_000, stall_timeout_s=5.0,
+            clock=clock, sleep=sleep)
+        with pytest.raises(LoopStalled):
+            learner.train_window()
+
+    @pytest.mark.slow
+    def test_sigkill_resume_matches_uninterrupted_subprocess(
+            self, tmp_path):
+        """The honest preemption: the learner subprocess is SIGKILLed
+        mid-window (kill:step@6 — no cleanup, no atexit), re-running the
+        identical command resumes and completes, and every window digest
+        matches a never-killed run of the same schedule."""
+        child = os.path.join(REPO_ROOT, "tests", "loop_learner_child.py")
+
+        def run(workdir, faults_spec=None):
+            env = {k: v for k, v in os.environ.items()
+                   if k != "DEEPGO_FAULTS"}
+            env["JAX_PLATFORMS"] = "cpu"
+            if faults_spec:
+                env["DEEPGO_FAULTS"] = faults_spec
+            return subprocess.run(
+                [sys.executable, child, "--dir", str(workdir),
+                 "--windows", "3", "--steps", "4"],
+                env=env, capture_output=True, text=True, timeout=300)
+
+        r = run(tmp_path / "killed", faults_spec="kill:step@6")
+        assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+        r = run(tmp_path / "killed")
+        assert r.returncode == 0, r.stderr[-2000:]
+        killed = json.loads(r.stdout.split("CHILD_DONE ", 1)[1])
+        r = run(tmp_path / "clean")
+        assert r.returncode == 0, r.stderr[-2000:]
+        clean = json.loads(r.stdout.split("CHILD_DONE ", 1)[1])
+        assert killed == clean and len(killed) == 3
+
+
+# ---------------------------------------------------------------------------
+# standard gate + gatekeeper
+
+
+class _FakeFleet:
+    def __init__(self):
+        self.reloaded = []
+
+    def reload(self, path):
+        self.reloaded.append(path)
+        return {"replicas": 2, "seconds": 0.0}
+
+
+class TestStandardGate:
+    def test_protocol_pins_match_r5_queue(self):
+        # the values tools/r5_value_loop.sh pinned by hand, now owned by
+        # one definition (the satellite's whole point)
+        assert match.GATE_GAMES == 1000
+        assert match.GATE_OPENING_PLIES == 8
+        assert match.GATE_SEED == 29
+        assert match.GATE_RANK == 8
+
+    def test_standard_gate_records_protocol(self):
+        from deepgo_tpu.agents import RandomAgent
+
+        a, b = RandomAgent(), RandomAgent()
+        _, _, stats = match.standard_gate(a, b, n_games=2, max_moves=10)
+        assert stats["protocol"]["opening_plies"] == 8
+        assert stats["protocol"]["seed"] == 29
+        assert 0.0 <= stats["win_rate_a"] <= 1.0
+
+
+class TestGatekeeper:
+    def test_identical_agents_split_the_pairs_and_reject(self, tmp_path):
+        """Challenger == incumbent under shared openings is exactly 50%
+        (the color-swapped rematch of a deterministic self-pair mirrors
+        every game), so the 55% gate deterministically rejects — the
+        no-evidence-no-promotion property."""
+        champ = tmp_path / "champion.npz"
+        chal = tmp_path / "challenger.npz"
+        make_policy_checkpoint(str(champ), seed=1)
+        make_policy_checkpoint(str(chal), seed=1)
+        gk = ArenaGatekeeper(str(champ), games=4, threshold=0.55,
+                             max_moves=20)
+        with pytest.raises(GateRejected) as err:
+            gk.evaluate(str(chal))
+        assert err.value.win_rate == pytest.approx(0.5)
+        assert gk.gates_rejected == 1
+
+    def test_pass_publishes_champion_and_reloads_fleet(self, tmp_path):
+        champ = tmp_path / "champion.npz"
+        chal = tmp_path / "challenger.npz"
+        make_policy_checkpoint(str(champ), seed=1, step=0)
+        make_policy_checkpoint(str(chal), seed=2, step=11)
+        fleet = _FakeFleet()
+        gk = ArenaGatekeeper(str(champ), games=2, threshold=0.0,
+                             max_moves=16, fleet=fleet)
+        record = gk.evaluate(str(chal))
+        assert record["outcome"] == "passed"
+        assert fleet.reloaded == [str(champ)]
+        # the champion slot now holds the challenger, atomically
+        assert ckpt.load_meta(str(champ))["step"] == 11
+        assert record["champion_step"] == 11
+        assert gk.gates_passed == 1
+
+    def test_corrupt_challenger_never_reaches_the_fleet(self, tmp_path):
+        champ = tmp_path / "champion.npz"
+        chal = tmp_path / "challenger.npz"
+        make_policy_checkpoint(str(champ), seed=1)
+        make_policy_checkpoint(str(chal), seed=2)
+        data = bytearray(chal.read_bytes())
+        data[len(data) // 2] ^= 0xFF  # one flipped byte mid-payload
+        chal.write_bytes(bytes(data))
+        fleet = _FakeFleet()
+        gk = ArenaGatekeeper(str(champ), games=2, threshold=0.0,
+                             max_moves=16, fleet=fleet)
+        with pytest.raises(ckpt.CheckpointError):
+            gk.evaluate(str(chal))
+        assert fleet.reloaded == []
+        assert ckpt.load_meta(str(champ))["id"] == "test-1"
+
+    def test_loop_gate_fault_site(self, tmp_path):
+        champ = tmp_path / "champion.npz"
+        make_policy_checkpoint(str(champ), seed=1)
+        gk = ArenaGatekeeper(str(champ), games=2, threshold=0.0)
+        faults.install("loop_gate:fail@1")
+        with pytest.raises(faults.InjectedFailure):
+            gk.evaluate(str(champ))
+
+
+# ---------------------------------------------------------------------------
+# cli serve --watch verification
+
+
+class TestServeWatchVerification:
+    def test_corrupt_watch_checkpoint_is_not_reloaded(self, tmp_path):
+        from deepgo_tpu.cli import verified_reload
+
+        path = tmp_path / "champion.npz"
+        make_policy_checkpoint(str(path), seed=1)
+        data = bytearray(path.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        path.write_bytes(bytes(data))
+        fleet = _FakeFleet()
+        assert verified_reload(fleet, str(path)) is None
+        assert fleet.reloaded == []
+
+    def test_valid_watch_checkpoint_reloads(self, tmp_path):
+        from deepgo_tpu.cli import verified_reload
+
+        path = tmp_path / "champion.npz"
+        make_policy_checkpoint(str(path), seed=1)
+        fleet = _FakeFleet()
+        assert verified_reload(fleet, str(path)) is not None
+        assert fleet.reloaded == [str(path)]
+
+
+# ---------------------------------------------------------------------------
+# the full in-process loop turn (the `make verify-loop` acceptance shape)
+
+
+class TestLoopTurn:
+    def test_one_full_turn_selfplay_to_champion(self, tmp_path):
+        cfg = LoopConfig(actors=1, fleet=2, games_per_round=2,
+                         max_moves=16, temperature=0.5,
+                         steps_per_window=4, min_window_positions=24,
+                         segment_games=2, gate_games=4,
+                         gate_threshold=0.0, windows=1,
+                         stall_timeout_s=180.0)
+        loop = ExpertIterationLoop(str(tmp_path / "run"), cfg,
+                                   TINY.replace(name="loop-turn"))
+        summary = loop.run()
+        assert summary["fatal"] == {}
+        assert summary["windows_trained"] == 1
+        assert summary["gates_passed"] == 1
+        # zero lost games: every game the actors acked is on disk
+        assert summary["games_acked"] == summary["games_durable"] > 0
+        # the served champion is the gated window-1 checkpoint
+        assert summary["champion_step"] == summary["learner_step"] == 4
+        assert summary["fleet_reloads"] >= 1
+        # the champion slot verifies end to end (what serve --watch and
+        # the next gate both rely on)
+        ckpt.verify_checkpoint(str(tmp_path / "run" / "champion.npz"))
+        # and the loop's own event stream recorded the turn
+        events = [json.loads(l)["kind"]
+                  for l in (tmp_path / "run" / "loop.jsonl")
+                  .read_text().splitlines() if l.strip()]
+        for kind in ("loop_start", "loop_ingest", "loop_window",
+                     "loop_gate", "loop_close"):
+            assert kind in events, (kind, set(events))
+
+    def test_rerun_resumes_and_extends(self, tmp_path):
+        """Re-running the identical command over the same run_dir picks
+        the loop up where the last run left it — the operational resume
+        contract cli loop documents."""
+        cfg = LoopConfig(actors=1, fleet=2, games_per_round=2,
+                         max_moves=16, temperature=0.5,
+                         steps_per_window=4, min_window_positions=24,
+                         segment_games=2, gate_games=4,
+                         gate_threshold=0.0, windows=1,
+                         stall_timeout_s=180.0)
+        ExpertIterationLoop(str(tmp_path / "run"), cfg,
+                            TINY.replace(name="loop-turn")).run()
+        cfg2 = dataclasses.replace(cfg, windows=2)
+        summary = ExpertIterationLoop(str(tmp_path / "run"), cfg2,
+                                      TINY.replace(name="loop-turn")).run()
+        assert summary["windows_trained"] == 2
+        assert summary["learner_step"] == 8
+        assert summary["champion_step"] == 8

@@ -1,4 +1,13 @@
 #!/bin/bash
+# SUPERSEDED by `python -m deepgo_tpu.cli loop` (docs/loop.md): the
+# hand-sequenced selfplay -> corpus -> train -> arena -> champion stages
+# below now run as one supervised, always-on service with a live replay
+# buffer, bit-exact learner resume, and fleet hot-reload on gate pass.
+# This script is kept as the reproducible record of the round-5
+# measurement campaign; its arena protocol pins moved into
+# match.standard_gate() (used here via --standard-gate) so the two paths
+# can never drift.
+#
 # Value-guided self-improvement loop: reproduce the round-4 rungs on a
 # fresh machine, then run the compounding iteration RESULTS.md sketched
 # for round 5.
@@ -39,9 +48,12 @@ vmatch() {  # vmatch <specA> <tag> [games] — vs oneply under the pins
   local mark=runs/r5logs/done_arena_$tag
   [ -f "$mark" ] && { echo "arena $tag already done"; return 0; }
   stage "arena $tag"
+  # --standard-gate applies the shared protocol pins from
+  # match.standard_gate (opening-plies 8, seed 29, rank 8, vs oneply) —
+  # one definition for this queue and the expert-iteration gatekeeper
   nice -n $N timeout 43200 python -u -m deepgo_tpu.arena \
-    --a "$a" --b oneply --games "$games" --rank 8 --seed 29 \
-    --opening-plies 8 >> runs/r5logs/arena.log 2>&1
+    --a "$a" --standard-gate --games "$games" \
+    >> runs/r5logs/arena.log 2>&1
   local rc=$?
   [ $rc -eq 0 ] && touch "$mark"
   echo "arena $tag rc=$rc"
