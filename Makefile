@@ -1,8 +1,8 @@
 # Developer entry points. `scripts/setup.sh` chains native + data + test.
 
-.PHONY: native data test test-full verify verify-faults verify-serving \
+.PHONY: native data test test-full lint verify verify-faults verify-serving \
     verify-resilience verify-fleet verify-distributed verify-obs \
-    verify-slo verify-loop bench bench-gate smoke clean
+    verify-slo verify-loop verify-analysis bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -13,6 +13,9 @@ data: native
 
 test:
 	python -m pytest tests/ -q
+
+lint:  # invariant linter + code<->docs grammar drift; exit != 0 on any strict finding
+	JAX_PLATFORMS=cpu python -m deepgo_tpu.cli lint
 
 test-full:  # every golden position, not the sampled sweep
 	DEEPGO_GOLDEN_FULL=1 python -m pytest tests/ -q
@@ -46,7 +49,11 @@ verify-slo:  # analysis layer: SLO burn windows, sentinel gate + flight recorder
 verify-loop:  # expert-iteration loop: replay-buffer durability, cursor-pinned bit-exact learner resume (SIGKILL included), gatekeeper, one full in-process loop turn
 	JAX_PLATFORMS=cpu python -m pytest tests/test_loop.py -q
 
-verify: verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-loop  # the full failure-model suite
+verify-analysis:  # invariant linter fixtures + clean-tree run + lock-order sanitizer
+	JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py \
+	    tests/test_lockcheck.py -q
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-loop verify-analysis  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -89,6 +89,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -145,12 +146,12 @@ def _record_last_good(result: dict) -> None:
     entry["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     entry["git_sha"] = gitinfo.git_sha() or "unknown"
     table[result["metric"]] = entry
-    tmp = LAST_GOOD_PATH + ".tmp"
     try:
-        with open(tmp, "w") as f:
+        from deepgo_tpu.utils.atomicio import atomic_write
+
+        with atomic_write(LAST_GOOD_PATH, mode="w") as f:
             json.dump(table, f, indent=1)
             f.write("\n")
-        os.replace(tmp, LAST_GOOD_PATH)
     except OSError as e:
         # a bookkeeping failure (read-only checkout, full disk) must not
         # turn a SUCCESSFUL measurement into a zero-output run — the very
@@ -758,6 +759,8 @@ def _bench_loop(on_tpu: bool, faults_spec: str | None = None) -> dict:
         from deepgo_tpu.utils import faults as faults_mod
 
         faults_mod.install(faults_spec)
+        # chaos soak = race hunt (docs/static_analysis.md)
+        os.environ.setdefault("DEEPGO_LOCKCHECK", "1")
     windows = 3
     cfg = LoopConfig(
         actors=2, fleet=2, games_per_round=3, max_moves=24,
@@ -813,9 +816,19 @@ def _bench_loop(on_tpu: bool, faults_spec: str | None = None) -> dict:
             "fleet_reloads": summary["fleet_reloads"],
             "seconds": round(dt, 2),
         }
+        from deepgo_tpu.analysis import lockcheck
+
+        if lockcheck.enabled():
+            lrep = lockcheck.report()
+            result["lockcheck"] = {"locks": len(lrep["locks"]),
+                                   "cycles": len(lrep["cycles"]),
+                                   "hazards": len(lrep["hazards"])}
         if faults_spec:
             result["faults"] = faults_spec
         errors = []
+        if result.get("lockcheck", {}).get("cycles"):
+            errors.append(f"{result['lockcheck']['cycles']} lock-order "
+                          "cycle(s) detected")
         if lost != 0:
             errors.append(f"{lost} acked game(s) not durable")
         if mismatches:
@@ -901,6 +914,10 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         from deepgo_tpu.utils import faults as faults_mod
 
         faults_mod.install(faults_spec)
+        # every chaos soak doubles as a race hunt: the lock-order
+        # sanitizer instruments engine/supervisor/fleet/obs locks created
+        # from here on (docs/static_analysis.md); cycles land in the JSON
+        os.environ.setdefault("DEEPGO_LOCKCHECK", "1")
     if fleet:
         sup = (SupervisorConfig(max_restarts=0, backoff_base_s=0.01,
                                 backoff_cap_s=0.1)
@@ -963,7 +980,8 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
                     healthz_codes.append((round(time.time(), 3), code))
                 healthz_stop.wait(0.02)
 
-        threading.Thread(target=poll_healthz, daemon=True).start()
+        threading.Thread(target=poll_healthz, name="bench-healthz-poll",
+                         daemon=True).start()
 
     rng = np.random.default_rng(0)
     packed, player, rank = _rand_batch(rng, (submitters,))
@@ -1028,10 +1046,12 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
                 reload_report.update(ok=False, error=repr(e))
 
         reload_report = {"ok": None}
-        reload_thread = threading.Thread(target=reloader, daemon=True)
+        reload_thread = threading.Thread(target=reloader,
+                                         name="bench-reloader", daemon=True)
 
     t0 = time.time()
-    threads = [threading.Thread(target=submitter, args=(i,))
+    threads = [threading.Thread(target=submitter, args=(i,),
+                                name=f"bench-submitter-{i}")
                for i in range(submitters)]
     for t in threads:
         t.start()
@@ -1049,6 +1069,20 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     if healthz_stop is not None:
         healthz_stop.set()
     engine.close()
+    lockcheck_report = None
+    from deepgo_tpu.analysis import lockcheck
+
+    if lockcheck.enabled():
+        lrep = lockcheck.report()
+        lockcheck_report = {"locks": len(lrep["locks"]),
+                            "cycles": len(lrep["cycles"]),
+                            "hazards": len(lrep["hazards"])}
+        for cyc in lrep["cycles"]:
+            print(f"bench: LOCK ORDER CYCLE {' -> '.join(cyc['cycle'])}",
+                  file=sys.stderr, flush=True)
+        if lrep["cycles"]:
+            errors.append(f"{len(lrep['cycles'])} lock-order cycle(s) "
+                          "detected")
     goodput = outcomes["ok"] / dt
     if fleet:
         fstats = stats["fleet"]
@@ -1078,6 +1112,8 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
             "replicas_serving": health["replicas_serving"],
             "fleet_state": health["state"],
         }
+        if lockcheck_report is not None:
+            result["lockcheck"] = lockcheck_report
         if faults_spec:
             result["faults"] = faults_spec
         if healthz_codes:
@@ -1112,6 +1148,8 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
                 "poisoned": health["poisoned"],
                 "breaker": health["breaker"]["state"],
             })
+        if lockcheck_report is not None:
+            result["lockcheck"] = lockcheck_report
     if errors:
         result["error"] = "; ".join(sorted(set(errors))[:3])
     return result

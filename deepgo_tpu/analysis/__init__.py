@@ -1,0 +1,23 @@
+"""Machine-checked invariants (docs/static_analysis.md).
+
+Two halves, one conviction: the guarantees PRs 1-8 advertise — bit-exact
+resume, zero lost games, zero-recompile hot reload — rest on code
+conventions (atomic writes, injectable clocks, named threads, typed
+errors, documented grammar) that review alone cannot hold at scale.
+
+  * :mod:`linter` / :mod:`grammar` — the AST invariant linter behind
+    ``cli lint`` / ``make lint``: per-rule checkers with file:line
+    findings, a reasoned inline-pragma allowlist, and JSON for CI.
+  * :mod:`lockcheck` — the opt-in (``DEEPGO_LOCKCHECK=1``) runtime
+    lock-order sanitizer: instrumented locks record the per-thread
+    acquisition graph across the dispatcher/supervisor/fleet/replay/obs
+    threads and report order-inversion cycles and long-hold hazards
+    through the flight recorder.
+
+Only :mod:`lockcheck` is imported eagerly — it is on the production lock
+construction path and must stay stdlib-only; the linter halves load on
+demand from the CLI and tests.
+"""
+
+from .lockcheck import enabled as lockcheck_enabled  # noqa: F401
+from .lockcheck import make_lock, make_rlock  # noqa: F401

@@ -1,0 +1,307 @@
+"""AST invariant linter (``cli lint`` / ``make lint``).
+
+Four per-file rules, each guarding a convention the system's headline
+guarantees rest on (docs/static_analysis.md has the full table):
+
+  * ``atomic-write`` — durable artifacts go through ``utils/atomicio``:
+    a raw ``open(path, "w")`` destroys the previous contents the moment
+    it runs, so a crash mid-write leaves a torn file where the recovery
+    artifact used to be. Write-mode ``open`` and ``np.save``/``np.savez``
+    straight to a path are findings; append-mode streams (JSONL sinks,
+    torn-tail tolerant by design) are not.
+  * ``determinism`` — step-indexed / replay / serving-dispatch modules
+    must be pure functions of (seed, step): ``time.time()``, module-level
+    ``random.*``, unseeded ``random.Random()``, and ``np.random`` global
+    state are findings there (injectable ``clock=``/``rng=`` is the fix;
+    ``np.random.default_rng(seed)`` and friends are fine).
+  * ``thread-discipline`` — every ``threading.Thread`` carries ``name=``
+    (leak reports and the lock sanitizer attribute by thread name) and
+    is either ``daemon=`` or joined somewhere in its module.
+  * ``typed-error`` — no bare ``except:`` anywhere; no ``assert`` in the
+    service layers (typed errors must survive ``python -O``).
+
+Findings carry file:line, rule id, and a fix hint. A narrow pragma
+allowlist (``# lint: allow[RULE] reason`` — reason mandatory) admits
+the rare legitimate exception without widening the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from .config import NP_RANDOM_OK, PRAGMA_RE, RULES, LintConfig
+
+_HINTS = {
+    "atomic-write": "route the write through utils/atomicio.atomic_write",
+    "determinism": "inject clock=/rng= (or np.random.default_rng(seed))",
+    "thread-discipline": "threading.Thread(..., name=..., daemon=True) "
+                         "or join() it",
+    "typed-error": "raise a typed error (survives `python -O`); "
+                   "catch specific exceptions",
+    "pragma": "pragmas need a reason: # lint: allow[RULE] why",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    level: str  # "strict" | "warn"
+    message: str
+
+    @property
+    def hint(self) -> str:
+        return _HINTS.get(self.rule, "")
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "level": self.level, "message": self.message,
+                "hint": self.hint}
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.level}] {self.rule}: "
+                f"{self.message}")
+
+
+def _const_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _dotted(node) -> str:
+    """'np.random.seed' for an Attribute chain, '' when not a pure chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _FileChecker(ast.NodeVisitor):
+    def __init__(self, rel: str, source: str, config: LintConfig):
+        self.rel = rel
+        self.source = source
+        self.config = config
+        self.findings: list[Finding] = []
+        self._has_join = ".join(" in source
+        self._det = config.in_scope(rel, config.determinism_scope)
+        self._assert = config.in_scope(rel, config.assert_scope)
+        self._atomic = rel not in config.atomic_exempt
+
+    def _add(self, rule: str, node, message: str) -> None:
+        self.findings.append(Finding(rule, self.rel, node.lineno,
+                                     "strict", message))
+
+    # -- atomic-write ------------------------------------------------------
+
+    def _check_open(self, node: ast.Call) -> None:
+        mode = None
+        if len(node.args) >= 2:
+            mode = _const_str(node.args[1])
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = _const_str(kw.value)
+        if mode and any(c in mode for c in "wx"):
+            self._add("atomic-write", node,
+                      f'raw open(..., "{mode}") on a durable path '
+                      "outside utils/atomicio")
+
+    def _check_np_save(self, node: ast.Call, fn: str) -> None:
+        if not node.args:
+            return
+        dst = node.args[0]
+        # np.save(f, ...) into a handle (atomic_write body) is fine; a
+        # path expression or literal bypasses the atomic writer
+        if isinstance(dst, (ast.Name, ast.Attribute)):
+            return
+        self._add("atomic-write", node,
+                  f"np.{fn} straight to a path bypasses utils/atomicio")
+
+    # -- determinism -------------------------------------------------------
+
+    def _check_determinism(self, node: ast.Call, dotted: str) -> None:
+        if dotted == "time.time":
+            self._add("determinism", node,
+                      "wall clock time.time() in a step-indexed/replay "
+                      "module")
+        elif dotted == "random.Random" and not node.args:
+            self._add("determinism", node,
+                      "unseeded random.Random() — hidden nondeterminism "
+                      "in a replay-bearing module")
+        elif dotted.startswith("random.") and dotted.count(".") == 1 \
+                and dotted != "random.Random":
+            self._add("determinism", node,
+                      f"global-state {dotted}() in a step-indexed/replay "
+                      "module")
+        elif dotted.startswith(("np.random.", "numpy.random.")):
+            fn = dotted.rsplit(".", 1)[1]
+            if fn not in NP_RANDOM_OK:
+                self._add("determinism", node,
+                          f"np.random.{fn} uses the global numpy RNG "
+                          "state")
+
+    # -- thread-discipline -------------------------------------------------
+
+    def _check_thread(self, node: ast.Call) -> None:
+        kwargs = {kw.arg for kw in node.keywords}
+        if None in kwargs:  # **kw — can't see through it
+            return
+        if "name" not in kwargs:
+            self._add("thread-discipline", node,
+                      "anonymous threading.Thread — leak reports and "
+                      "the lock sanitizer cannot attribute it")
+        if "daemon" not in kwargs and not self._has_join:
+            self._add("thread-discipline", node,
+                      "thread is neither daemon= nor joined in this "
+                      "module")
+
+    # -- visitors ----------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "open" and self._atomic:
+            self._check_open(node)
+        dotted = _dotted(func)
+        if dotted in ("threading.Thread",) or (
+                isinstance(func, ast.Name) and func.id == "Thread"):
+            self._check_thread(node)
+        if self._atomic and dotted.startswith(("np.", "numpy.")):
+            fn = dotted.split(".", 1)[1]
+            if fn in ("save", "savez", "savez_compressed"):
+                self._check_np_save(node, fn)
+        if self._det and dotted:
+            self._check_determinism(node, dotted)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._add("typed-error", node,
+                      "bare except: swallows SystemExit/KeyboardInterrupt "
+                      "and hides the fault type")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if self._assert:
+            self._add("typed-error", node,
+                      "assert in service-layer code vanishes under "
+                      "`python -O`")
+        self.generic_visit(node)
+
+
+def _collect_pragmas(rel: str, source: str) -> tuple[dict, list[Finding]]:
+    """line -> (rule, reason) for every pragma; malformed ones (missing
+    reason, unknown rule) are findings themselves."""
+    pragmas: dict[int, tuple[str, str]] = {}
+    findings: list[Finding] = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = PRAGMA_RE.search(text)
+        if not m:
+            continue
+        rule, reason = m.group(1), m.group(2)
+        if rule not in RULES:
+            findings.append(Finding("pragma", rel, i, "strict",
+                                    f"allow[{rule}] names no known rule"))
+            continue
+        if not reason:
+            findings.append(Finding("pragma", rel, i, "strict",
+                                    f"allow[{rule}] without a reason"))
+            continue
+        pragmas[i] = (rule, reason)
+    return pragmas, findings
+
+
+def lint_file(path: str, rel: str, config: LintConfig) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    pragmas, findings = _collect_pragmas(rel, source)
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as e:
+        findings.append(Finding("typed-error", rel, e.lineno or 1,
+                                "strict", f"file does not parse: {e.msg}"))
+        return findings
+    checker = _FileChecker(rel, source, config)
+    checker.visit(tree)
+    lines = source.splitlines()
+    for f_ in checker.findings:
+        allowed = False
+        for at in (f_.line, f_.line - 1):
+            entry = pragmas.get(at)
+            if entry and entry[0] == f_.rule:
+                # a standalone pragma line covers the NEXT line; an
+                # end-of-line pragma covers its own
+                if at == f_.line or lines[at - 1].lstrip().startswith("#"):
+                    allowed = True
+                    break
+        if not allowed:
+            findings.append(f_)
+    return findings
+
+
+def _iter_py(root: str, sub: str, config: LintConfig):
+    top = os.path.join(root, sub)
+    if os.path.isfile(top):
+        yield top, sub.replace(os.sep, "/")
+        return
+    for dirpath, dirnames, filenames in os.walk(top):
+        dirnames[:] = [d for d in dirnames if d not in config.skip_parts]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                full = os.path.join(dirpath, name)
+                yield full, os.path.relpath(full, root).replace(os.sep, "/")
+
+
+def run_lint(root: str, config: LintConfig | None = None,
+             paths: list[str] | None = None,
+             grammar: bool = True) -> list[Finding]:
+    """Lint the repo at ``root`` (or just ``paths``, repo-relative).
+
+    Explicit paths open every rule's scope gate (``all_scopes``) and skip
+    the repo-level grammar check — that is the fixture-testing mode."""
+    config = config or LintConfig()
+    findings: list[Finding] = []
+    if paths is not None:
+        config = dataclasses.replace(config, all_scopes=True)
+        targets = [(os.path.join(root, p), p.replace(os.sep, "/"))
+                   for p in paths]
+        for full, rel in targets:
+            findings.extend(lint_file(full, rel, config))
+        return findings
+
+    for sub in config.strict_roots:
+        for full, rel in _iter_py(root, sub, config):
+            findings.extend(lint_file(full, rel, config))
+    for sub in config.warn_roots:
+        for full, rel in _iter_py(root, sub, config):
+            for f_ in lint_file(full, rel, config):
+                f_.level = "warn"
+                findings.append(f_)
+    if grammar:
+        from .grammar import lint_grammar
+
+        findings.extend(lint_grammar(root, config))
+    findings.sort(key=lambda f_: (f_.path, f_.line, f_.rule))
+    return findings
+
+
+def format_report(findings: list[Finding], files: int | None = None) -> str:
+    out = [f.format() for f in findings]
+    strict = sum(1 for f in findings if f.level == "strict")
+    warn = len(findings) - strict
+    tail = f"lint: {strict} finding(s), {warn} warning(s)"
+    if files is not None:
+        tail += f" over {files} file(s)"
+    if strict:
+        hints = {f.rule: f.hint for f in findings
+                 if f.level == "strict" and f.hint}
+        for rule, hint in sorted(hints.items()):
+            out.append(f"  fix[{rule}]: {hint}")
+    out.append(tail)
+    return "\n".join(out)

@@ -19,6 +19,11 @@
   python -m deepgo_tpu.cli obs         offline observability report: join a
                                        run's metrics/trace/elastic JSONL
                                        streams into one per-stage table
+  python -m deepgo_tpu.cli lint        invariant linter: machine-check the
+                                       atomic-write/determinism/thread/
+                                       typed-error disciplines and the
+                                       code<->docs grammar
+                                       (docs/static_analysis.md)
 
 Config overrides are ``--set key=value`` pairs against ExperimentConfig
 (the reference's prototype-override tables, experiments.lua:19-31, and its
@@ -320,6 +325,33 @@ def cmd_obs(args) -> None:
         print(format_report(summary))
 
 
+def cmd_lint(args) -> None:
+    """Invariant linter + grammar drift checker (docs/static_analysis.md).
+
+    Exits non-zero on any strict finding: raw durable writes outside
+    utils/atomicio, nondeterminism in step-indexed/replay modules,
+    anonymous/unsupervised threads, service-layer asserts, and code<->docs
+    grammar drift. ``tools/`` is linted at warn level only (legacy
+    one-offs; the exemption is checked in at analysis/config.py)."""
+    import json as _json
+
+    from .analysis.linter import format_report, run_lint
+
+    findings = run_lint(args.root, paths=args.paths or None,
+                        grammar=not args.no_grammar)
+    strict = sum(1 for f in findings if f.level == "strict")
+    if args.json:
+        print(_json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "strict": strict,
+            "warn": len(findings) - strict,
+        }, indent=1))
+    else:
+        print(format_report(findings))
+    if strict:
+        raise SystemExit(1)
+
+
 def cmd_eval(args) -> None:
     exp = Experiment.load(args.checkpoint)
     result = exp.evaluate(split=args.split, limit=args.limit)
@@ -535,6 +567,20 @@ def main(argv=None) -> None:
                    help="learner ExperimentConfig overrides (model size, "
                         "batch_size, rate, ... — the train grammar)")
     p.set_defaults(fn=cmd_loop)
+
+    p = sub.add_parser("lint", help="invariant linter: atomic-write/"
+                       "determinism/thread/typed-error discipline + "
+                       "code<->docs grammar drift (docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   help="specific files to lint with every rule in scope "
+                        "(default: the configured repo sweep)")
+    p.add_argument("--root", default=".",
+                   help="repo root the configured sweep runs from")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings for CI")
+    p.add_argument("--no-grammar", action="store_true",
+                   help="skip the repo-level code<->docs drift check")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("obs", help="offline observability report: one "
                                    "per-stage table (loader wait, "

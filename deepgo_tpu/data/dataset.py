@@ -35,6 +35,7 @@ import numpy as np
 
 from ..features import PACKED_CHANNELS
 from ..utils import faults
+from ..utils.atomicio import atomic_write
 from ..utils.retry import retry_with_backoff
 from .. import BOARD_SIZE
 
@@ -184,6 +185,7 @@ class DatasetWriter:
     def __init__(self, out_dir: str):
         os.makedirs(out_dir, exist_ok=True)
         self.out_dir = out_dir
+        # lint: allow[atomic-write] streamed .tmp + fsync + os.replace in finalize() is the atomic pattern, sized beyond one buffer
         self._planes_f = open(os.path.join(out_dir, "planes.bin.tmp"), "wb")
         self._meta: list[np.ndarray] = []
         self._games: list[dict] = []
@@ -214,8 +216,12 @@ class DatasetWriter:
                    os.path.join(self.out_dir, "planes.bin"))
         meta = (np.concatenate(self._meta) if self._meta
                 else np.zeros((0, META_COLS), dtype=np.int32))
-        np.save(os.path.join(self.out_dir, "meta.npy"), meta)
-        with open(os.path.join(self.out_dir, "games.json"), "w") as f:
+        with atomic_write(os.path.join(self.out_dir, "meta.npy")) as f:
+            np.save(f, meta)
+        # games.json is the shard's index-commit point: readers treat its
+        # appearance as "this shard is complete", so it must flip atomically
+        with atomic_write(os.path.join(self.out_dir, "games.json"),
+                          mode="w") as f:
             json.dump(self._games, f)
         # a winner.npy sidecar describes the OLD shard; a re-transcription
         # with the same position count would otherwise silently keep stale

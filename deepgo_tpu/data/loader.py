@@ -209,9 +209,10 @@ class AsyncLoader:
             # silently drop the caller's placement
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            assert isinstance(sharding, NamedSharding), (
-                "stack >= 1 with a non-NamedSharding `sharding` requires an "
-                "explicit `stack_sharding`")
+            if not isinstance(sharding, NamedSharding):
+                raise TypeError(
+                    "stack >= 1 with a non-NamedSharding `sharding` "
+                    "requires an explicit `stack_sharding`")
             stack_sharding = NamedSharding(sharding.mesh,
                                            P(None, *sharding.spec))
         self.stack_sharding = stack_sharding

@@ -182,9 +182,12 @@ class Experiment:
             faults.install(cfg.faults)
         n_devices = len(jax.devices())
         dp = cfg.data_parallel or max(1, n_devices // cfg.tensor_parallel)
-        assert cfg.batch_size % dp == 0, (
-            f"batch_size {cfg.batch_size} must divide over {dp} data-parallel devices"
-        )
+        if cfg.batch_size % dp != 0:
+            # config validation must survive `python -O` (same contract
+            # as the anchor check below)
+            raise ValueError(
+                f"batch_size {cfg.batch_size} must divide over {dp} "
+                "data-parallel devices")
         self.mesh = make_mesh(dp, cfg.tensor_parallel)
         self.wire = cfg.wire_format
         if self.wire == "auto":
@@ -251,7 +254,8 @@ class Experiment:
     def run(self, iters: int) -> dict:
         """Train for ``iters`` steps; returns the run summary record
         (reference Experiment:run, experiments.lua:110-122)."""
-        assert iters > 0
+        if iters <= 0:
+            raise ValueError(f"iters must be positive, got {iters}")
         if not self.initialized:
             self.init()
         cfg = self.config

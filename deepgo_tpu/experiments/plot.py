@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import os
 
+from ..utils.atomicio import atomic_write
 from ..utils.metrics import read_jsonl
 
 
@@ -59,7 +60,9 @@ def main(argv=None) -> None:
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
     csv_path = args.out + ".csv"
-    with open(csv_path, "w") as f:
+    # atomic: repeated plot runs overwrite in place; a crash mid-write
+    # must not truncate the previous good CSV (docs/static_analysis.md)
+    with atomic_write(csv_path, mode="w") as f:
         f.write("run,step,validation_cost,validation_accuracy\n")
         for run, rows in curves.items():
             for step, cost, acc in rows:

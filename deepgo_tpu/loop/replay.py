@@ -49,6 +49,7 @@ import threading
 
 import numpy as np
 
+from ..analysis.lockcheck import make_rlock
 from ..data.dataset import (M_BLACK_RANK, M_PLAYER, M_WHITE_RANK, M_X, M_Y,
                             META_COLS, RECORD_SHAPE)
 from ..utils import faults
@@ -249,7 +250,8 @@ class ReplayBuffer:
         self.segment_games = segment_games
         self.capacity_positions = capacity_positions
         self._metrics = metrics
-        self._lock = threading.RLock()
+        # reentrant: the seal path re-enters through ingest bookkeeping
+        self._lock = make_rlock("loop.replay")
         os.makedirs(os.path.join(buffer_dir, GAMES_DIR), exist_ok=True)
         self._recover()
 

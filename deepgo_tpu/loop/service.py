@@ -48,6 +48,7 @@ import time
 
 import jax
 
+from ..analysis.lockcheck import make_lock
 from ..experiments import ExperimentConfig
 from ..experiments import checkpoint as ckpt
 from ..models import policy_cnn
@@ -131,7 +132,7 @@ class ExpertIterationLoop:
         self._learner_done = threading.Event()
         self._gate_queue: queue.Queue = queue.Queue()
         self._rng = random.Random(self.config.seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("loop.service")
         self.restarts: dict[str, int] = {}
         self.fatal: dict[str, str] = {}
         self.gates_rejected = 0

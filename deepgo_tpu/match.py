@@ -185,6 +185,8 @@ def play_match(agent_a: Agent, agent_b: Agent, n_games: int = 32,
 def main(argv=None) -> None:
     import os
 
+    from .utils.atomicio import atomic_write
+
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--a", default="model:small", help="agent A spec")
     ap.add_argument("--b", default="random", help="agent B spec")
@@ -280,7 +282,11 @@ def main(argv=None) -> None:
             # approximation) but not stamped into the record
             done = g.passes >= 2
             finished += done
-            with open(os.path.join(args.sgf_out, f"match_{i:04d}.sgf"), "w") as f:
+            # atomic: a kill mid-write must not leave a torn SGF that a
+            # later corpus build half-parses (docs/static_analysis.md)
+            with atomic_write(os.path.join(args.sgf_out,
+                                           f"match_{i:04d}.sgf"),
+                              mode="w") as f:
                 f.write(to_sgf(g, result=s.result_string() if done else None,
                                komi=args.komi))
         print(f"wrote {len(games)} SGFs ({finished} finished/scored, "

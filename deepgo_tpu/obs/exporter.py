@@ -32,6 +32,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..analysis.lockcheck import make_lock
 from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
 
 
@@ -49,7 +50,7 @@ class JsonlSink:
         self.path = path
         self.max_bytes = max_bytes
         self.max_files = max_files
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"obs.sink.{os.path.basename(path)}")
         self._f = open(path, "a", buffering=1)
         self._size = self._f.tell()
 
@@ -230,7 +231,7 @@ class ObsExporter:
                  registry: MetricsRegistry | None = None):
         self.registry = registry or get_registry()
         self._health_fns: dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.exporter")
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True
         self._server.exporter = self  # type: ignore[attr-defined]

@@ -36,6 +36,8 @@ import re
 import threading
 import time
 
+from ..analysis.lockcheck import make_lock
+
 # seconds-scale latency ladder: sub-millisecond loader waits up to
 # multi-second recoveries land in distinct buckets
 DEFAULT_BUCKETS_S = (
@@ -62,7 +64,7 @@ class Metric:
             raise ValueError(f"invalid metric name {name!r}")
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"obs.metric.{name}")
         self._series: dict[tuple, object] = {}
 
     def labelnames(self) -> list[tuple]:
@@ -273,7 +275,7 @@ class MetricsRegistry:
 
     def __init__(self, clock=time.time):
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.registry")
         self._metrics: dict[str, Metric] = {}
 
     def _get_or_create(self, cls, name: str, help: str, **kw) -> Metric:

@@ -40,6 +40,7 @@ import time
 from collections import deque
 from dataclasses import dataclass
 
+from ..analysis.lockcheck import make_lock
 from .registry import MetricsRegistry, get_registry
 from .spans import add_span_listener, remove_span_listener
 
@@ -150,7 +151,7 @@ class FlightRecorder:
         self.enabled = False
         self.dump_dir: str | None = None
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.flight")
         self._snapshots: deque = deque(maxlen=max_snapshots)
         self._spans: deque = deque(maxlen=max_spans)
         self.dumps: list[str] = []

@@ -102,12 +102,23 @@ def global_array_from_local(mesh, local_batch: dict) -> dict:
     """Assemble a globally-sharded batch from this host's local samples
     (each process calls this with its own shard). The ``dist_collective``
     fault site fires at this host->global boundary — the first place a
-    batch becomes a cross-host object."""
+    batch becomes a cross-host object. Assembly time feeds the
+    ``collective`` attribution bucket (docs/observability.md)."""
+    import time
+
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..obs import get_registry
 
     faults.check("dist_collective")
     sharding = NamedSharding(mesh, P("data"))
-    return {
+    t0 = time.monotonic()
+    out = {
         k: jax.make_array_from_process_local_data(sharding, np.asarray(v))
         for k, v in local_batch.items()
     }
+    get_registry().histogram(
+        "deepgo_collective_seconds",
+        "host-side cross-host array assembly").observe(
+            time.monotonic() - t0)
+    return out

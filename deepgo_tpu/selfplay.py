@@ -328,6 +328,8 @@ def to_sgf(game: GameState, black_rank: int = 9, white_rank: int = 9,
 def main(argv=None) -> None:
     import os
 
+    from .utils.atomicio import atomic_write
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--games", type=int, default=32)
     ap.add_argument("--checkpoint", help="policy checkpoint (default: random init)")
@@ -384,7 +386,11 @@ def main(argv=None) -> None:
             # on a move-cap-truncated board would be arbitrary
             s = area_score(g.stones) if g.passes >= 2 else None
             scored += s is not None
-            with open(os.path.join(args.sgf_out, f"game_{i:04d}.sgf"), "w") as f:
+            # atomic: selfplay SGFs feed corpus builds; never leave a torn
+            # record under the final name (docs/static_analysis.md)
+            with atomic_write(os.path.join(args.sgf_out,
+                                           f"game_{i:04d}.sgf"),
+                              mode="w") as f:
                 f.write(to_sgf(g, result=s and s.result_string(),
                                komi=s and s.komi))
         print(f"wrote {len(games)} SGFs ({scored} finished/scored) "

@@ -31,6 +31,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..analysis.lockcheck import make_lock
 from ..obs import get_registry
 from ..utils import faults
 from .buckets import DEFAULT_BUCKETS, BucketLadder
@@ -115,7 +116,7 @@ class InferenceEngine:
         self._closing = threading.Event()   # no new submits
         self._cancel = threading.Event()    # fail pending instead of draining
         self._error: BaseException | None = None
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"engine.{name}")
         self._latencies: deque[float] = deque(maxlen=self.config.latency_window)
         # forward-only durations of recent successful dispatches: the
         # supervisor's admission control estimates queue wait from their

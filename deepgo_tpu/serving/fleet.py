@@ -69,6 +69,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..analysis.lockcheck import make_lock
 from ..obs import get_registry
 from ..obs.sentinel import flight_dump
 from ..utils import faults
@@ -190,13 +191,14 @@ class FleetRouter:
         self._metrics = metrics
         self._clock = clock
         self._sleep = sleep
+        # lint: allow[determinism] backoff jitter only — placement and results never depend on it; tests inject rng=
         self._rng = rng if rng is not None else random.Random()
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"fleet.{name}")
         self._closing = threading.Event()
         self._events: queue.Queue = queue.Queue()
         self._rr = 0                       # round-robin tie-break cursor
         self._current_params = None        # set by reload; respawns converge
-        self._reload_mutex = threading.Lock()
+        self._reload_mutex = make_lock(f"fleet.{name}.reload")
         self._failovers = 0
         self._respawns = 0
         self._reloads = 0

@@ -29,6 +29,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..analysis.lockcheck import make_lock
 from .engine import EngineError
 
 
@@ -77,14 +78,15 @@ class CircuitBreaker:
     """
 
     def __init__(self, failures: int = 5, reset_timeout_s: float = 30.0,
-                 clock=time.monotonic, on_transition=None):
+                 clock=time.monotonic, on_transition=None,
+                 name: str = "breaker"):
         if failures < 1:
             raise ValueError(f"failures must be >= 1, got {failures}")
         self.failures = failures
         self.reset_timeout_s = reset_timeout_s
         self._clock = clock
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = make_lock(name)
         self._state = "closed"
         self._consecutive = 0
         self._opened_at: float | None = None

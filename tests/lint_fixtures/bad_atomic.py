@@ -1,0 +1,23 @@
+"""Known-bad fixture: every write here violates atomic-write. Line
+numbers are pinned by tests/test_analysis.py — edit with care."""
+import json
+
+import numpy as np
+
+
+def write_report(path, rows):
+    with open(path, "w") as f:  # line 9: raw truncating write
+        json.dump(rows, f)
+
+
+def save_weights(path, arr):
+    np.save(path + ".npy", arr)  # line 14: np.save straight to a path
+
+
+def save_bundle(path, **arrs):
+    np.savez(path + ".npz", **arrs)  # line 18: np.savez to a path expr
+
+
+def append_log(path, line):
+    with open(path, "a") as f:  # append streams are torn-tail tolerant: OK
+        f.write(line)

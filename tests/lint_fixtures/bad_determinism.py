@@ -1,0 +1,29 @@
+"""Known-bad fixture for the determinism rule (explicit-path mode puts
+this file in scope). Lines pinned by tests/test_analysis.py."""
+import random
+import time
+
+import numpy as np
+
+
+def stamp():
+    return time.time()  # line 10: wall clock in a replay-bearing module
+
+
+def jitter():
+    return random.random()  # line 14: module-level global RNG
+
+
+def make_rng():
+    return random.Random()  # line 18: unseeded instance
+
+
+def sample(n):
+    return np.random.rand(n)  # line 22: numpy global RNG state
+
+
+def good(seed, n):
+    rng = np.random.default_rng(seed)  # seeded, owned stream: OK
+    t0 = time.monotonic()  # monotonic interval timing: OK
+    _ = random.Random(seed)  # seeded instance: OK
+    return rng.random(n), t0

@@ -1,0 +1,19 @@
+"""Pragma-grammar fixture. Lines pinned by test_analysis.py."""
+import json
+
+
+def reasoned(path, rows):
+    # lint: allow[atomic-write] fixture: a reasoned pragma suppresses the next line
+    with open(path, "w") as f:
+        json.dump(rows, f)
+
+
+def unreasoned(path, rows):
+    with open(path, "w") as f:  # lint: allow[atomic-write]
+        json.dump(rows, f)  # line 12 pragma has no reason: two findings
+
+
+def unknown_rule(path, rows):
+    # lint: allow[made-up-rule] this rule id does not exist
+    with open(path, "w") as f:
+        json.dump(rows, f)

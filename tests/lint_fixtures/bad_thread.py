@@ -1,0 +1,15 @@
+"""Known-bad fixture for thread-discipline: nothing in this module ever
+joins a thread, on purpose. Lines pinned by test_analysis.py."""
+import threading
+
+
+def start_anonymous(fn):
+    t = threading.Thread(target=fn)  # line 7: no name, no daemon/join
+    t.start()
+    return t
+
+
+def start_named_but_leaked(fn):
+    t = threading.Thread(target=fn, name="worker")  # line 13: never joined
+    t.start()
+    return t

@@ -1,0 +1,13 @@
+"""Known-bad fixture for typed-error. Lines pinned by test_analysis.py."""
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except:  # line 7: bare except
+        return None
+
+
+def check(x):
+    assert x > 0  # line 12: assert vanishes under python -O
+    return x

@@ -1,0 +1,30 @@
+"""Fixture that satisfies every rule even in explicit-path (all-scopes)
+mode — the linter must report nothing here."""
+import threading
+import time
+
+import numpy as np
+
+
+def sample(seed, n):
+    rng = np.random.default_rng(seed)
+    return rng.random(n)
+
+
+def timed(fn):
+    t0 = time.monotonic()
+    out = fn()
+    return out, time.monotonic() - t0
+
+
+def run_worker(fn):
+    t = threading.Thread(target=fn, name="fixture-worker", daemon=True)
+    t.start()
+    t.join(timeout=1.0)
+    return t
+
+
+def typed(x):
+    if x <= 0:
+        raise ValueError(f"x must be positive, got {x}")
+    return x

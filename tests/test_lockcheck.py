@@ -1,0 +1,208 @@
+"""Lock-order sanitizer: inversion cycles, long-hold hazards, RLock
+re-entry, flight-recorder dumps, and the zero-overhead-when-off contract
+(docs/static_analysis.md)."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from deepgo_tpu.analysis import lockcheck
+
+
+@pytest.fixture
+def sanitizer():
+    lockcheck.enable(True)
+    lockcheck.reset()
+    yield
+    lockcheck.enable(None)
+    lockcheck.reset()
+
+
+def test_disabled_returns_plain_locks():
+    lockcheck.enable(False)
+    try:
+        lock = lockcheck.make_lock("plain")
+        rlock = lockcheck.make_rlock("plain-r")
+        assert not isinstance(lock, lockcheck.TrackedLock)
+        assert not isinstance(rlock, lockcheck.TrackedLock)
+        with lock, rlock:  # still real locks
+            pass
+    finally:
+        lockcheck.enable(None)
+
+
+def test_env_var_enables(monkeypatch):
+    lockcheck.enable(None)
+    monkeypatch.setenv("DEEPGO_LOCKCHECK", "1")
+    assert lockcheck.enabled()
+    assert isinstance(lockcheck.make_lock("via-env"), lockcheck.TrackedLock)
+    monkeypatch.setenv("DEEPGO_LOCKCHECK", "0")
+    assert not lockcheck.enabled()
+
+
+def test_ab_ba_inversion_reports_typed_cycle(sanitizer):
+    a = lockcheck.make_lock("A")
+    b = lockcheck.make_lock("B")
+    with a:
+        with b:
+            pass
+    assert lockcheck.report()["cycles"] == []  # one order alone is fine
+    with b:
+        with a:
+            pass
+    report = lockcheck.report()
+    assert len(report["cycles"]) == 1
+    cycle = report["cycles"][0]
+    assert cycle["kind"] == "lock_order_cycle"
+    assert set(cycle["cycle"]) == {"A", "B"}
+    assert cycle["edge"]["from"] == "B" and cycle["edge"]["to"] == "A"
+    assert "test_lockcheck.py" in cycle["edge"]["site"]
+    assert report["edges"] == {"A": {"B": 1}, "B": {"A": 1}}
+
+
+def test_cross_thread_inversion_attributes_thread_name(sanitizer):
+    a = lockcheck.make_lock("A")
+    b = lockcheck.make_lock("B")
+    first_done = threading.Event()
+
+    def forward():
+        with a:
+            with b:
+                pass
+        first_done.set()
+
+    def backward():
+        first_done.wait(5.0)
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=forward, name="lockcheck-fwd", daemon=True)
+    t2 = threading.Thread(target=backward, name="lockcheck-bwd", daemon=True)
+    t1.start(), t2.start()
+    t1.join(5.0), t2.join(5.0)
+    cycles = lockcheck.report()["cycles"]
+    assert len(cycles) == 1
+    assert cycles[0]["thread"] == "lockcheck-bwd"  # the inverting thread
+
+
+def test_three_lock_cycle(sanitizer):
+    a, b, c = (lockcheck.make_lock(n) for n in "ABC")
+    for first, second in ((a, b), (b, c)):
+        with first:
+            with second:
+                pass
+    assert lockcheck.report()["cycles"] == []  # A->B->C is a clean order
+    with c:
+        with a:
+            pass
+    cycles = lockcheck.report()["cycles"]
+    assert len(cycles) == 1
+    assert set(cycles[0]["cycle"]) == {"A", "B", "C"}
+
+
+def test_duplicate_cycle_reported_once(sanitizer):
+    a = lockcheck.make_lock("A")
+    b = lockcheck.make_lock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert len(lockcheck.report()["cycles"]) == 1
+
+
+def test_rlock_reentry_is_not_a_self_edge(sanitizer):
+    r = lockcheck.make_rlock("R")
+    outer = lockcheck.make_lock("outer")
+    with outer:
+        with r:
+            with r:  # re-entry must not edge R->R or crash the stack
+                pass
+    report = lockcheck.report()
+    assert report["cycles"] == []
+    assert report["edges"] == {"outer": {"R": 2}}
+
+
+def test_long_hold_hazard_via_fake_clock():
+    t = [0.0]
+    lockcheck.enable(True)
+    lockcheck.reset(clock=lambda: t[0], hold_warn_s=0.5)
+    try:
+        lock = lockcheck.make_lock("slow")
+        for _ in range(2):  # same site twice: reported once, not per hold
+            lock.acquire()
+            t[0] += 2.0  # "blocking call" while holding the lock
+            lock.release()
+        hazards = lockcheck.report()["hazards"]
+        assert len(hazards) == 1
+        assert hazards[0]["kind"] == "lock_held_across_blocking_call"
+        assert hazards[0]["lock"] == "slow"
+        assert hazards[0]["held_s"] == 2.0
+    finally:
+        lockcheck.enable(None)
+        lockcheck.reset()
+
+
+def test_cycle_dumps_through_flight_recorder(sanitizer, tmp_path):
+    from deepgo_tpu.obs import sentinel
+
+    recorder = sentinel.FlightRecorder()
+    recorder.configure(str(tmp_path))
+    old = sentinel._recorder
+    sentinel._recorder = recorder
+    try:
+        a = lockcheck.make_lock("A")
+        b = lockcheck.make_lock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        dump = os.path.join(str(tmp_path), "flight-0000.json")
+        assert os.path.exists(dump)
+        with open(dump) as f:
+            record = json.load(f)
+        assert record["reason"] == "lock_order_cycle"
+        assert set(record["detail"]["cycle"]) == {"A", "B"}
+        assert record["detail"]["kind"] == "lock_order_cycle"
+    finally:
+        recorder.close()
+        sentinel._recorder = old
+
+
+def test_tracked_locks_still_mutually_exclude(sanitizer):
+    lock = lockcheck.make_lock("mutex")
+    counter = [0]
+
+    def bump():
+        for _ in range(200):
+            with lock:
+                counter[0] += 1
+
+    threads = [threading.Thread(target=bump, name=f"lockcheck-bump-{i}",
+                                daemon=True) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert counter[0] == 800
+    assert lockcheck.report()["cycles"] == []
+
+
+def test_obs_registry_locks_are_tracked_when_enabled(sanitizer):
+    from deepgo_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("deepgo_lockcheck_fixture_total", "fixture")
+    c.inc(3)
+    snap = reg.snapshot()
+    assert snap["metrics"]["deepgo_lockcheck_fixture_total"]["series"][""] == 3
+    names = lockcheck.report()["locks"]
+    assert "obs.registry" in names
+    assert "obs.metric.deepgo_lockcheck_fixture_total" in names
