@@ -2,7 +2,8 @@
 
 .PHONY: native data test test-full lint verify verify-faults verify-serving \
     verify-resilience verify-fleet verify-distributed verify-obs \
-    verify-slo verify-loop verify-analysis bench bench-gate smoke clean
+    verify-slo verify-trace verify-loop verify-analysis bench bench-gate \
+    smoke clean
 
 native:
 	$(MAKE) -C native
@@ -46,6 +47,9 @@ verify-slo:  # analysis layer: SLO burn windows, sentinel gate + flight recorder
 	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py tests/test_sentinel.py \
 	    tests/test_attribution.py -q
 
+verify-trace:  # request tracing: cross-thread span handoff, trace continuity through restart/failover, bounded exemplar sampling, lineage chain, cli trace
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q
+
 verify-loop:  # expert-iteration loop: replay-buffer durability, cursor-pinned bit-exact learner resume (SIGKILL included), gatekeeper, one full in-process loop turn
 	JAX_PLATFORMS=cpu python -m pytest tests/test_loop.py -q
 
@@ -53,7 +57,7 @@ verify-analysis:  # invariant linter fixtures + clean-tree run + lock-order sani
 	JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py \
 	    tests/test_lockcheck.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-loop verify-analysis  # the full failure-model suite
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis  # the full failure-model suite
 
 bench:
 	python bench.py

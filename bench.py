@@ -859,6 +859,58 @@ def _attach_obs(result: dict, exporter) -> None:
     exporter.close()
 
 
+def _tracing_ab(forward, params, ecfg, tracing_mod,
+                submitters: int = 4, per_thread: int = 48) -> dict:
+    """The tracing overhead A/B: identical concurrent-submitter bursts
+    through fresh engines over the SAME warm jitted forward, tracing off
+    vs on, three bursts per arm interleaved with the best rate kept per
+    arm (scheduler noise hits both arms; the best-of comparison isolates
+    the instrumentation cost). The budget is <2% boards/sec."""
+    import threading
+
+    from deepgo_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(7)
+    packed, player, rank = _rand_batch(rng, (submitters,))
+    boards = submitters * per_thread
+
+    def burst(tag: str) -> float:
+        eng = InferenceEngine(forward, params, ecfg, name=f"ab-{tag}")
+        eng.warmup()
+
+        def submitter(i: int) -> None:
+            for _ in range(per_thread):
+                eng.submit(packed[i], int(player[i]), int(rank[i])).result()
+
+        threads = [threading.Thread(target=submitter, args=(i,),
+                                    name=f"bench-ab-{tag}-{i}")
+                   for i in range(submitters)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.time() - t0
+        eng.close()
+        return boards / dt
+
+    rates = {"off": 0.0, "on": 0.0}
+    for i in range(3):
+        tracing_mod.disable_tracing()
+        rates["off"] = max(rates["off"], burst(f"off{i}"))
+        tracing_mod.configure_tracing(sink=None)
+        rates["on"] = max(rates["on"], burst(f"on{i}"))
+    tracing_mod.disable_tracing()
+    overhead = (rates["off"] - rates["on"]) / rates["off"]
+    return {
+        "boards_per_burst": boards,
+        "off_boards_per_sec": round(rates["off"], 1),
+        "on_boards_per_sec": round(rates["on"], 1),
+        "overhead_frac": round(overhead, 4),
+        "ok": overhead < 0.02,
+    }
+
+
 def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
                    exporter=None, fleet: int | None = None) -> dict:
     """Micro-batching engine throughput under concurrent submitters.
@@ -910,6 +962,22 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     params = policy_cnn.init(jax.random.key(0), cfg)
     forward = make_log_prob_fn(cfg)
     ecfg = EngineConfig(buckets=buckets, max_wait_ms=2.0)
+    # request-scoped tracing rides the whole run (obs/tracing.py): every
+    # submit gets a timeline, tail exemplars stream to trace.jsonl next
+    # to the flight dumps, and the JSON proves no-orphan completeness +
+    # the kill-induced failover as a multi-hop trace. The tracing-on vs
+    # tracing-off A/B at the end pins the overhead under the 2% budget.
+    from deepgo_tpu.obs import JsonlSink
+    from deepgo_tpu.obs import tracing as tracing_mod
+
+    trace_dir = os.environ.get("DEEPGO_FLIGHT_DIR", ".")
+    trace_path = os.path.join(trace_dir, "trace.jsonl")
+    # DEEPGO_FLIGHT=0 is the operator's no-artifacts switch (same
+    # contract as the flight recorder): tracing stays armed, but the
+    # exemplar stream keeps to the in-memory ring
+    trace_sink = (None if os.environ.get("DEEPGO_FLIGHT") == "0"
+                  else JsonlSink(trace_path))
+    trace_rec = tracing_mod.configure_tracing(sink=trace_sink)
     if faults_spec:
         from deepgo_tpu.utils import faults as faults_mod
 
@@ -1084,6 +1152,39 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
             errors.append(f"{len(lrep['cycles'])} lock-order cycle(s) "
                           "detected")
     goodput = outcomes["ok"] / dt
+    # tracing accounting: started == finished (no orphan ids) and every
+    # ok timeline carries queued/dispatched/resolved; the chaos kill
+    # shows up as >= 1 multi-hop trace on fleet runs
+    trace_stats = trace_rec.stats()
+    exemplars = trace_rec.exemplars()
+    slowest = max(exemplars, key=lambda r: r["duration_s"]) \
+        if exemplars else None
+    tracing_block = {
+        **trace_stats,
+        "complete": (trace_stats["orphans"] == 0
+                     and trace_stats["incomplete"] == 0),
+    }
+    if trace_sink is not None:
+        tracing_block["exemplar_file"] = trace_path
+    if slowest is not None:
+        tracing_block["slowest_exemplar"] = {
+            "trace_id": slowest["trace_id"],
+            "duration_ms": round(slowest["duration_s"] * 1000, 3),
+            "hops": len(slowest.get("hops", [])),
+        }
+    if trace_stats["orphans"] or trace_stats["incomplete"]:
+        errors.append(
+            f"tracing: {trace_stats['orphans']} orphan / "
+            f"{trace_stats['incomplete']} incomplete timeline(s)")
+    # the overhead A/B: identical bursts through a fresh engine on the
+    # SAME warm jitted forward, tracing off vs on, best-of-3 per arm
+    if faults_spec:
+        from deepgo_tpu.utils import faults as faults_mod
+
+        faults_mod.reset()  # the chaos plan must not bleed into the A/B
+    tracing_block["ab"] = _tracing_ab(forward, params, ecfg, tracing_mod)
+    if trace_sink is not None:
+        trace_sink.close()
     if fleet:
         fstats = stats["fleet"]
         result = {
@@ -1150,6 +1251,7 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
             })
         if lockcheck_report is not None:
             result["lockcheck"] = lockcheck_report
+    result["tracing"] = tracing_block
     if errors:
         result["error"] = "; ".join(sorted(set(errors))[:3])
     return result

@@ -73,10 +73,19 @@ class LintConfig:
     # fault-site tables (serving.md only cross-references them)
     grammar_docs: tuple = ("docs/observability.md", "docs/robustness.md",
                            "docs/loop.md")
+    # event/metric prefixes the drift checker enforces bidirectionally;
+    # the first entry MUST stay "deepgo_" (the metric namespace — the
+    # rest are JSONL event-kind namespaces). trace_* (request exemplars)
+    # and lineage_* (the loop provenance chain) joined in ISSUE 10.
+    grammar_prefixes: tuple = ("deepgo_", "obs_", "loop_", "fleet_",
+                               "trace_", "lineage_")
     # doc tokens that share a grammar prefix but are not metrics/events:
     # bench JSON keys and similar
     grammar_ignore: frozenset = frozenset({
-        "obs_registry", "loop_games_per_hour",
+        "obs_registry", "loop_games_per_hour", "trace_id",
+        # flight-dump section / JSON keys that share the trace_ prefix
+        # but are not JSONL event kinds
+        "trace_exemplars",
     })
     # files whose emissions feed the grammar check
     grammar_code_roots: tuple = ("deepgo_tpu", "bench.py")

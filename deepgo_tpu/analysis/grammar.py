@@ -37,7 +37,9 @@ import re
 
 from .config import LintConfig
 
-GRAMMAR_PREFIXES = ("deepgo_", "obs_", "loop_", "fleet_")
+# the checked-in policy owns the prefix list (analysis/config.py); this
+# module-level alias keeps the historical import surface working
+GRAMMAR_PREFIXES = LintConfig().grammar_prefixes
 
 _BACKTICK_RE = re.compile(r"`([^`]+)`")
 _TOKEN_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
@@ -56,8 +58,11 @@ def _first_str(node: ast.Call) -> str | None:
 class _CodeGrammar(ast.NodeVisitor):
     """tokens -> (rel, line) of the first emission site."""
 
-    def __init__(self, rel: str):
+    def __init__(self, rel: str, prefixes: tuple = GRAMMAR_PREFIXES):
         self.rel = rel
+        # every prefix except the metric namespace is an event namespace
+        self._event_prefixes = tuple(p for p in prefixes
+                                     if p != "deepgo_")
         self.metrics: dict[str, tuple] = {}
         self.events: dict[str, tuple] = {}
         self.sites: dict[str, tuple] = {}
@@ -72,7 +77,7 @@ class _CodeGrammar(ast.NodeVisitor):
                         and arg.startswith("deepgo_"):
                     self.metrics.setdefault(arg, where)
                 elif func.attr == "write" \
-                        and arg.startswith(GRAMMAR_PREFIXES[1:]):
+                        and arg.startswith(self._event_prefixes):
                     self.events.setdefault(arg, where)
                 elif func.attr == "check" \
                         and isinstance(func.value, ast.Name) \
@@ -106,7 +111,7 @@ def extract_code_grammar(root: str, config: LintConfig) -> dict:
                     tree = ast.parse(f.read(), filename=rel)
             except (OSError, SyntaxError):
                 continue  # the linter proper reports parse failures
-            v = _CodeGrammar(rel)
+            v = _CodeGrammar(rel, config.grammar_prefixes)
             v.visit(tree)
             for src, dst in ((v.metrics, metrics), (v.events, events),
                              (v.sites, sites)):
@@ -165,7 +170,7 @@ def extract_doc_grammar(root: str, config: LintConfig) -> dict:
                 tok = _clean(m.group(1))
                 if tok is None:
                     continue
-                if tok.startswith(GRAMMAR_PREFIXES):
+                if tok.startswith(config.grammar_prefixes):
                     full.setdefault(tok, (doc, lineno))
                     last_full = tok
                 elif tok.startswith("_") and last_full is not None:

@@ -19,6 +19,12 @@
   python -m deepgo_tpu.cli obs         offline observability report: join a
                                        run's metrics/trace/elastic JSONL
                                        streams into one per-stage table
+  python -m deepgo_tpu.cli trace       reconstruct one request's waterfall
+                                       (from sampled trace_request
+                                       exemplars) or a champion's lineage
+                                       chain (games -> segments -> window
+                                       -> gate -> champion) from a run
+                                       directory's JSONL streams
   python -m deepgo_tpu.cli lint        invariant linter: machine-check the
                                        atomic-write/determinism/thread/
                                        typed-error disciplines and the
@@ -270,6 +276,7 @@ def cmd_loop(args) -> None:
     from .loop import ExpertIterationLoop, LoopConfig
 
     config = LoopConfig(
+        trace=args.trace,
         actors=args.actors,
         fleet=args.fleet,
         games_per_round=args.games_per_round,
@@ -323,6 +330,38 @@ def cmd_obs(args) -> None:
         print(_json.dumps(summary, indent=1, default=str))
     else:
         print(format_report(summary))
+
+
+def cmd_trace(args) -> None:
+    """Request waterfall / lineage chain reconstruction (obs/tracing.py).
+
+    ``ID`` is a trace-id prefix (from the `cli obs` exemplar table, a
+    `trace_request` record, or a flight dump), ``champion`` / a window
+    number / a params-digest prefix for the provenance chain. With no ID,
+    lists what the run directory has to offer."""
+    import json as _json
+
+    from .obs.tracing import load_trace_events, trace_report
+
+    if args.id is None:
+        events = load_trace_events(args.run_dir)
+        print(trace_report(args.run_dir, ""))
+        if not events["requests"] and not events["lineage"]:
+            raise SystemExit(1)
+        return
+    if args.json:
+        from .obs.tracing import build_lineage, find_request
+
+        events = load_trace_events(args.run_dir)
+        record = find_request(events, args.id)
+        out = record if record is not None \
+            else build_lineage(events, args.id)
+        if out is None:
+            raise SystemExit(f"no trace or lineage matches {args.id!r} "
+                             f"in {args.run_dir}")
+        print(_json.dumps(out, indent=1, default=str))
+        return
+    print(trace_report(args.run_dir, args.id))
 
 
 def cmd_lint(args) -> None:
@@ -560,6 +599,12 @@ def main(argv=None) -> None:
     p.add_argument("--max-wait-ms", type=float, default=2.0,
                    help="per-replica dispatcher coalescing window")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trace", action="store_true",
+                   help="arm request-scoped tracing: per-request "
+                        "timelines through the fleet with tail-exemplar "
+                        "sampling streamed to <run-dir>/trace.jsonl — "
+                        "`cli trace RUN_DIR ID` renders the waterfalls "
+                        "(docs/observability.md)")
     p.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                    help="live /metrics + /healthz (fleet + loop "
                         "progress) for the duration of the run")
@@ -581,6 +626,21 @@ def main(argv=None) -> None:
     p.add_argument("--no-grammar", action="store_true",
                    help="skip the repo-level code<->docs drift check")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("trace", help="reconstruct one request's waterfall "
+                                     "or a champion's lineage chain from "
+                                     "a run directory's sampled "
+                                     "trace_request / lineage event "
+                                     "streams (docs/observability.md)")
+    p.add_argument("run_dir")
+    p.add_argument("id", nargs="?", default=None,
+                   help="a trace-id prefix (request waterfall), "
+                        "`champion`, a window number, or a params-digest "
+                        "prefix (lineage chain); omit to list what the "
+                        "run has")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw record/chain as JSON")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("obs", help="offline observability report: one "
                                    "per-stage table (loader wait, "

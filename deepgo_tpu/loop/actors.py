@@ -111,13 +111,22 @@ class SelfplayActor:
             packed, meta = game_records(g, self.rank, self.rank)
             winner = (area_score(g.stones, komi=self.komi).winner
                       if g.passes >= 2 else 0)
-            self.buffer.ingest_game(packed, meta, winner=winner,
-                                    source=f"actor-{self.actor_id}")
+            gid = self.buffer.ingest_game(packed, meta, winner=winner,
+                                          source=f"actor-{self.actor_id}")
             ingested += 1
             positions += len(g.moves)
             self.games_acked += 1
             self._obs_games.inc(1)
             self._obs_positions.inc(len(g.moves))
+            if self._metrics is not None:
+                # the lineage chain's leaf: game gid -> its producer.
+                # `cli trace RUN_DIR champion` joins these against the
+                # seal/window/gate records to answer "which games
+                # trained the champion currently serving"
+                self._metrics.write(
+                    "lineage_game", gid=gid, positions=len(g.moves),
+                    winner=winner, source=f"actor-{self.actor_id}",
+                    round=self.round)
         record = {
             "actor": self.actor_id,
             "round": self.round,

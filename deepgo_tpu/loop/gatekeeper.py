@@ -115,6 +115,12 @@ class ArenaGatekeeper:
         ckpt.verify_checkpoint(challenger_path)
         _, c_params, c_cfg = load_policy(challenger_path)
         _, i_params, i_cfg = load_policy(self.champion_path)
+        # the challenger's bitwise identity — the key the lineage chain
+        # joins on (it equals the learner's lineage_window digest for the
+        # window that published this challenger)
+        from .learner import params_digest
+
+        challenger_digest = params_digest(c_params)
         challenger = PolicyAgent(c_params, c_cfg, name="challenger",
                                  rank=match.GATE_RANK)
         incumbent = PolicyAgent(i_params, i_cfg, name="champion",
@@ -132,6 +138,10 @@ class ArenaGatekeeper:
                                     threshold=self.threshold,
                                     games=self.games,
                                     seconds=round(self._clock() - t0, 3))
+                self._metrics.write("lineage_gate", outcome="rejected",
+                                    digest=challenger_digest,
+                                    win_rate=round(win_rate, 4),
+                                    games=self.games)
             raise GateRejected(win_rate, self.threshold, stats)
         publish_checkpoint(challenger_path, self.champion_path)
         reload_report = None
@@ -154,5 +164,16 @@ class ArenaGatekeeper:
         if self._metrics is not None:
             self._metrics.write("loop_gate", **{
                 k: v for k, v in record.items() if k != "reload"})
+            self._metrics.write("lineage_gate", outcome="passed",
+                                digest=challenger_digest,
+                                win_rate=round(win_rate, 4),
+                                games=self.games)
+            # the chain's root: what the fleet serves NOW, and the
+            # digest that walks back to its training window
+            self._metrics.write("lineage_champion",
+                                digest=challenger_digest,
+                                step=record["champion_step"],
+                                path=self.champion_path,
+                                source="gate")
         record["stats"] = stats
         return record

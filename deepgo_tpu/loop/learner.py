@@ -294,6 +294,15 @@ class ContinuousLearner:
         if self._metrics is not None:
             self._metrics.write("loop_window", **{
                 k: v for k, v in record.items() if k != "checkpoint"})
+            # the lineage chain's extent->window->checkpoint join: the
+            # params_digest here is the identity the gatekeeper's verdict
+            # and the champion publish carry forward, so provenance walks
+            # champion -> gate -> THIS window -> extent -> segments
+            self._metrics.write("lineage_window", window=self.window,
+                                step0=step0, step1=self.step,
+                                extent=[lo, hi], version=version,
+                                scheme=self.scheme, digest=digest,
+                                checkpoint=path)
         return record
 
     def _meta(self) -> dict:

@@ -413,6 +413,15 @@ class ReplayBuffer:
                                     games=len(games),
                                     positions=seg.positions,
                                     sealed_hi=self.sealed_hi)
+                # the lineage chain's game->segment join: which gids own
+                # which logical position range, so a window's frozen
+                # extent resolves back to the games inside it
+                self._metrics.write("lineage_segment", segment=name,
+                                    version=self.version,
+                                    lo=seg.lo, hi=seg.hi,
+                                    first_gid=seg.first_gid,
+                                    last_gid=seg.last_gid,
+                                    games=len(games))
             return self.version
 
     def _write_index(self) -> None:

@@ -106,6 +106,10 @@ class LoopConfig:
     replica_max_restarts: int | None = None
     max_wait_ms: float = 2.0
     seed: int = 0
+    # request-scoped tracing (obs/tracing.py): arm the exemplar sampler
+    # over the fleet's serving path, streaming trace_request records to
+    # <run_dir>/trace.jsonl so `cli trace` can render waterfalls offline
+    trace: bool = False
 
 
 class ExpertIterationLoop:
@@ -128,6 +132,13 @@ class ExpertIterationLoop:
         self.champion_path = os.path.join(run_dir, CHAMPION_NAME)
         self.challenger_path = os.path.join(run_dir, CHALLENGER_NAME)
         self.metrics = MetricsWriter(os.path.join(run_dir, "loop.jsonl"))
+        self._trace_sink = None
+        if self.config.trace:
+            from ..obs import JsonlSink, configure_tracing
+
+            self._trace_sink = JsonlSink(os.path.join(run_dir,
+                                                      "trace.jsonl"))
+            configure_tracing(sink=self._trace_sink)
         self._stop = threading.Event()
         self._learner_done = threading.Event()
         self._gate_queue: queue.Queue = queue.Queue()
@@ -146,9 +157,20 @@ class ExpertIterationLoop:
             "typed LoopStalled events (a stage starved past its budget)")
 
         lcfg = learner_config or ExperimentConfig(name="loop-learner")
-        self._ensure_champion(lcfg, seed_checkpoint)
+        bootstrap_source = self._ensure_champion(lcfg, seed_checkpoint)
         _, self._champ_params, self._model_cfg = _load_champion(
             self.champion_path)
+        if bootstrap_source is not None:
+            # the provenance chain's root for a brand-new run: a champion
+            # that was NOT earned through a gate (seed checkpoint or
+            # fresh init), so `cli trace RUN_DIR champion` can say where
+            # the incumbent came from even before the first gate pass
+            from .learner import params_digest
+
+            self.metrics.write(
+                "lineage_champion", digest=params_digest(self._champ_params),
+                step=ckpt.load_meta(self.champion_path).get("step"),
+                path=self.champion_path, source=bootstrap_source)
         cfg = self.config
         sup = (None if cfg.replica_max_restarts is None
                else SupervisorConfig(max_restarts=cfg.replica_max_restarts,
@@ -192,20 +214,23 @@ class ExpertIterationLoop:
     # -- bootstrap ---------------------------------------------------------
 
     def _ensure_champion(self, lcfg: ExperimentConfig,
-                         seed_checkpoint: str | None) -> None:
+                         seed_checkpoint: str | None) -> str | None:
         """The loop needs an incumbent before anything runs: an existing
         champion.npz wins (the loop is resuming), else the seed
         checkpoint is published into the slot, else a fresh random init
-        (step 0 — any trained challenger should eventually beat it)."""
+        (step 0 — any trained challenger should eventually beat it).
+        Returns the bootstrap source ("seed" / "init") when a NEW
+        champion was published, None on resume — the lineage root
+        event is only written for champions this call created."""
         if os.path.exists(self.champion_path):
             ckpt.verify_checkpoint(self.champion_path)
-            return
+            return None
         if seed_checkpoint:
             from .gatekeeper import publish_checkpoint
 
             ckpt.verify_checkpoint(seed_checkpoint)
             publish_checkpoint(seed_checkpoint, self.champion_path)
-            return
+            return "seed"
         model_cfg = lcfg.model_config()
         params = policy_cnn.init(jax.random.key(lcfg.seed), model_cfg)
         opt = OPTIMIZERS[lcfg.optimizer]
@@ -217,6 +242,7 @@ class ExpertIterationLoop:
                                  "validation_history": [],
                                  "config": lcfg.to_dict(),
                              })
+        return "init"
 
     # -- supervision -------------------------------------------------------
 
@@ -370,8 +396,16 @@ class ExpertIterationLoop:
                 t.join(timeout=30)
             summary = self.summary()
             summary["seconds"] = round(time.monotonic() - t0, 3)
+            if self._trace_sink is not None:
+                from ..obs import get_trace_recorder
+
+                rec = get_trace_recorder()
+                if rec is not None:
+                    summary["tracing"] = rec.stats()
             self.metrics.write("loop_close", **summary)
             self.fleet.close()
+            if self._trace_sink is not None:
+                self._trace_sink.close()
             self.metrics.close()
         if self.fatal.get("loop", "").startswith("LoopStalled"):
             raise LoopStalled(self.fatal["loop"])

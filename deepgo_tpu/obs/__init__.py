@@ -27,7 +27,12 @@ plus the analysis-and-enforcement layer on top (ISSUE 6):
     component;
   * ``sentinel``  — the noise-aware bench regression gate
     (``bench.py --gate`` vs BENCH_LAST_GOOD.json) and the ring-buffer
-    crash flight recorder dumped on restart/HostLost/fast-burn/watchdog.
+    crash flight recorder dumped on restart/HostLost/fast-burn/watchdog;
+  * ``tracing``   — request-scoped end-to-end timelines through the
+    serving path (queued/routed/coalesced/dispatched/resolved + failover
+    hops, one trace id surviving restarts), bounded-memory tail-exemplar
+    sampling folded into flight-recorder dumps, and the loop's
+    ``lineage_*`` provenance chain (``cli trace RUN_DIR ID``).
 
 Finding scaling bottlenecks is a measurement problem first (FireCaffe,
 arXiv:1511.00175; arXiv:1711.00705): every future perf claim in this
@@ -36,9 +41,13 @@ repo starts from these numbers. See docs/observability.md.
 
 from .registry import (DEFAULT_BUCKETS_S, Counter, Gauge,  # noqa: F401
                        Histogram, MetricsRegistry, get_registry)
-from .spans import (add_span_listener, current_span_id,  # noqa: F401
-                    get_trace_sink, remove_span_listener, set_trace_sink,
-                    span, trace_to)
+from .spans import (add_span_listener, attach_context,  # noqa: F401
+                    capture_context, current_span_id, get_trace_sink,
+                    remove_span_listener, set_trace_sink, span, trace_to)
+from .tracing import (TraceContext, TraceRecorder,  # noqa: F401
+                      configure_tracing, disable_tracing,
+                      get_trace_recorder, start_request, trace_report,
+                      tracing_enabled)
 from .exporter import (JsonlSink, ObsExporter,  # noqa: F401
                        health_from_engine, health_from_ledger,
                        render_prometheus, sink_files, start_exporter)

@@ -210,6 +210,18 @@ class _Handler(BaseHTTPRequestHandler):
             payload, healthy = exporter.check_health()
             body = (json.dumps(payload, default=str) + "\n").encode()
             self._reply(200 if healthy else 503, body, "application/json")
+        elif path == "/trace":
+            # the live tail-exemplar view: the request-tracing ring +
+            # its accounting, while the process serves (obs/tracing.py)
+            from .tracing import get_trace_recorder
+
+            rec = get_trace_recorder()
+            payload = {"enabled": rec is not None}
+            if rec is not None:
+                payload["stats"] = rec.stats()
+                payload["exemplars"] = rec.exemplars()
+            body = (json.dumps(payload, default=str) + "\n").encode()
+            self._reply(200, body, "application/json")
         else:
             self._reply(404, b"not found\n", "text/plain")
 

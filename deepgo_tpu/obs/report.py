@@ -68,8 +68,14 @@ def _hist_row(name: str, snap: dict, unit_scale: float = 1000.0) -> dict:
 def summarize_run(run_dir: str) -> dict:
     """The joined per-stage summary for one run directory."""
     metrics = read_events(os.path.join(run_dir, "metrics.jsonl"))
-    spans = [r for r in read_events(os.path.join(run_dir, "trace.jsonl"))
-             if r.get("kind") == "obs_span"]
+    # loop runs stream their events (loop_* / fleet_* / lineage_*) to
+    # loop.jsonl — same grammar, different file; fold them in so the
+    # loop and fleet sections below see both deployment shapes
+    metrics.extend(read_events(os.path.join(run_dir, "loop.jsonl")))
+    trace_stream = read_events(os.path.join(run_dir, "trace.jsonl"))
+    spans = [r for r in trace_stream if r.get("kind") == "obs_span"]
+    requests = [r for r in trace_stream
+                if r.get("kind") == "trace_request"]
     elastic: list[dict] = []
     for p in sorted(glob.glob(os.path.join(run_dir, "elastic-*.jsonl"))):
         elastic.extend(read_events(p))
@@ -100,6 +106,7 @@ def summarize_run(run_dir: str) -> dict:
     # dispatch latency, step windows) — the last one wins: it is the
     # close-time state and subsumes the others
     snaps = [r for r in metrics if r.get("kind") == "obs_snapshot"]
+    hists: dict = {}
     if snaps:
         hists = snaps[-1].get("metrics", {})
         stage_of = {
@@ -107,6 +114,7 @@ def summarize_run(run_dir: str) -> dict:
             "deepgo_train_window_seconds": "train_window",
             "deepgo_serving_dispatch_seconds": "serving_dispatch",
             "deepgo_serving_request_seconds": "serving_request",
+            "deepgo_fleet_failover_seconds": "fleet_failover",
         }
         for metric_name, stage in stage_of.items():
             m = hists.get(metric_name)
@@ -168,10 +176,138 @@ def summarize_run(run_dir: str) -> dict:
     restarts = [r for r in metrics if r.get("kind") == "serving_restart"]
     poisons = [r for r in metrics if r.get("kind") == "serving_poison"]
     if restarts or poisons:
-        summary["events"]["serving"] = {
-            "restarts": len(restarts),
-            "poisoned": len(poisons),
-        }
+        # merge, don't assign: the snapshot section above may already
+        # have parked the supervisor counter block under this key
+        summary["events"].setdefault("serving", {}).update(
+            restarts=len(restarts), poisoned=len(poisons))
+
+    def _counter_series(metric_name: str) -> dict:
+        m = hists.get(metric_name)
+        if m and m.get("kind") == "counter":
+            return {lbl: v for lbl, v in m["series"].items()}
+        return {}
+
+    def _gauge_value(metric_name: str):
+        m = hists.get(metric_name)
+        if m and m.get("kind") == "gauge" and m["series"]:
+            return list(m["series"].values())[-1]
+        return None
+
+    # ---- fleet (router counters, per-replica restart/failover/respawn
+    # attribution — the deepgo_fleet_* / fleet_* grammar, previously
+    # invisible in this report)
+    fleet_sec: dict = {}
+    for short, metric_name in (("failovers", "deepgo_fleet_failovers_total"),
+                               ("respawns", "deepgo_fleet_respawns_total"),
+                               ("reloads", "deepgo_fleet_reloads_total")):
+        series = _counter_series(metric_name)
+        if series:
+            fleet_sec[short] = int(sum(series.values()))
+    shed = _counter_series("deepgo_fleet_shed_total")
+    if shed:
+        fleet_sec["shed"] = {lbl: int(v) for lbl, v in sorted(shed.items())}
+    if fleet_sec:
+        # per-replica restarts: the supervisor counter's engine label IS
+        # the replica name when the engines sit behind a fleet router
+        per_engine = _counter_series("deepgo_serving_restarts_total")
+        if per_engine:
+            fleet_sec["replica_restarts"] = {
+                lbl or "(unlabeled)": int(v)
+                for lbl, v in sorted(per_engine.items())}
+    fleet_events = [r for r in metrics
+                    if str(r.get("kind", "")).startswith("fleet_")]
+    if fleet_events:
+        by_replica: dict = {}
+        for r in fleet_events:
+            if r["kind"] == "fleet_respawn" and "replica" in r:
+                key = str(r["replica"])
+                by_replica[key] = by_replica.get(key, 0) + 1
+        fleet_sec.setdefault(
+            "respawns", sum(1 for r in fleet_events
+                            if r["kind"] == "fleet_respawn"))
+        if by_replica:
+            fleet_sec["respawns_by_replica"] = by_replica
+        failed = [r for r in fleet_events
+                  if r["kind"] == "fleet_replica_failed"]
+        if failed:
+            fleet_sec["replicas_failed"] = [r.get("replica") for r in failed]
+        reload_events = [r for r in fleet_events
+                         if r["kind"] == "fleet_reload"]
+        if reload_events:
+            fleet_sec.setdefault("reloads", len(reload_events))
+    if fleet_sec:
+        summary["events"]["fleet"] = fleet_sec
+
+    # ---- loop (the deepgo_loop_* / loop_* expert-iteration grammar)
+    loop_sec: dict = {}
+    for short, metric_name in (
+            ("games_ingested", "deepgo_loop_games_ingested_total"),
+            ("positions_ingested", "deepgo_loop_positions_ingested_total"),
+            ("windows_trained", "deepgo_loop_windows_trained_total"),
+            ("gates_passed", "deepgo_loop_gates_passed_total"),
+            ("gates_rejected", "deepgo_loop_gates_rejected_total"),
+            ("stalls", "deepgo_loop_stalls_total")):
+        series = _counter_series(metric_name)
+        if series:
+            loop_sec[short] = int(sum(series.values()))
+    comp_restarts = _counter_series("deepgo_loop_component_restarts_total")
+    if comp_restarts:
+        loop_sec["component_restarts"] = {
+            lbl or "(unlabeled)": int(v)
+            for lbl, v in sorted(comp_restarts.items())}
+    step = _gauge_value("deepgo_loop_learner_step")
+    if step is not None:
+        loop_sec["learner_step"] = int(step)
+    loop_events = [r for r in metrics
+                   if str(r.get("kind", "")).startswith("loop_")]
+    if loop_events:
+        loop_sec.setdefault(
+            "windows_trained",
+            sum(1 for r in loop_events if r["kind"] == "loop_window"))
+        loop_sec.setdefault(
+            "games_ingested",
+            sum(1 for r in loop_events if r["kind"] == "loop_ingest"))
+        gates = [r for r in loop_events if r["kind"] == "loop_gate"]
+        if gates:
+            loop_sec.setdefault(
+                "gates_passed",
+                sum(1 for r in gates if r.get("outcome") == "passed"))
+            loop_sec.setdefault(
+                "gates_rejected",
+                sum(1 for r in gates if r.get("outcome") == "rejected")
+                + sum(1 for r in loop_events
+                      if r["kind"] == "loop_gate_rejected"))
+        crashes: dict = {}
+        for r in loop_events:
+            if r["kind"] == "loop_restart":
+                key = str(r.get("component", "?"))
+                crashes[key] = crashes.get(key, 0) + 1
+        if crashes:
+            loop_sec.setdefault("component_restarts", crashes)
+        closes = [r for r in loop_events if r["kind"] == "loop_close"]
+        if closes:
+            last = closes[-1]
+            for k in ("games_acked", "games_durable", "champion_step"):
+                if last.get(k) is not None:
+                    loop_sec[k] = last[k]
+    if loop_sec:
+        summary["events"]["loop"] = loop_sec
+
+    # ---- slowest-request exemplars (trace_request records sampled by
+    # obs/tracing.py: the tail anatomy next to the aggregate table)
+    if requests:
+        top = sorted(requests,
+                     key=lambda r: -float(r.get("duration_s", 0.0)))[:10]
+        summary["exemplars"] = [{
+            "trace_id": r.get("trace_id"),
+            "duration_ms": round(float(r.get("duration_s", 0.0)) * 1000, 3),
+            "status": r.get("status"),
+            "tier": r.get("tier"),
+            "replica": r.get("replica"),
+            "bucket": r.get("bucket"),
+            "hops": len(r.get("hops") or []),
+            "events": len(r.get("events") or []),
+        } for r in top]
 
     # ---- elastic recovery (per-host streams)
     recoveries = [r for r in elastic if r.get("kind") == "recovery"]
@@ -258,6 +394,29 @@ def format_report(summary: dict) -> str:
         else:
             for item in payload:
                 lines.append(f"  {item}")
+    exemplars = summary.get("exemplars")
+    if exemplars:
+        lines.append("")
+        lines.append("slowest requests (sampled exemplars — "
+                     "`cli trace RUN_DIR <id>` for the waterfall):")
+        cols = ["trace_id", "ms", "status", "tier", "replica", "bucket",
+                "hops"]
+        rows = [[str(e.get("trace_id", "")),
+                 str(e.get("duration_ms", "")),
+                 str(e.get("status", "")),
+                 str(e.get("tier") or ""),
+                 str(e.get("replica") if e.get("replica") is not None
+                     else ""),
+                 str(e.get("bucket") if e.get("bucket") is not None
+                     else ""),
+                 str(e.get("hops", 0))] for e in exemplars]
+        widths = [max(len(c), *(len(r[i]) for r in rows))
+                  for i, c in enumerate(cols)]
+        lines.append("  " + "  ".join(c.ljust(w)
+                                      for c, w in zip(cols, widths)))
+        for r in rows:
+            lines.append("  " + "  ".join(v.ljust(w)
+                                          for v, w in zip(r, widths)))
     att = summary.get("attribution")
     if att:
         lines.append("")

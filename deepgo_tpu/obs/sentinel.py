@@ -154,6 +154,11 @@ class FlightRecorder:
         self._lock = make_lock("obs.flight")
         self._snapshots: deque = deque(maxlen=max_snapshots)
         self._spans: deque = deque(maxlen=max_spans)
+        # extra dump sections: name -> zero-arg callable returning JSON-
+        # serializable data, evaluated at dump time (the request-tracing
+        # exemplar ring registers here, so every incident postmortem
+        # carries the slow/failed request anatomy, not just aggregates)
+        self._sections: dict[str, object] = {}
         self.dumps: list[str] = []
 
     def configure(self, dump_dir: str, window_s: float | None = None,
@@ -174,6 +179,17 @@ class FlightRecorder:
         if self.enabled:
             self.enabled = False
             remove_span_listener(self.record_span)
+
+    def add_section(self, name: str, fn) -> None:
+        """Register ``fn() -> json-serializable`` to ride in every dump
+        under ``name``. Re-registering a name replaces the provider;
+        a raising provider is reported inline, never masks the dump."""
+        with self._lock:
+            self._sections[name] = fn
+
+    def remove_section(self, name: str) -> None:
+        with self._lock:
+            self._sections.pop(name, None)
 
     def record_span(self, record: dict) -> None:
         if not self.enabled:
@@ -227,6 +243,13 @@ class FlightRecorder:
             # "time" (its clock), which must not mask the ring position
             snapshots = [{**s, "time": t} for t, s in self._snapshots]
             spans = list(self._spans)
+            sections = dict(self._sections)
+        extra = {}
+        for name, fn in sections.items():
+            try:
+                extra[name] = fn()
+            except Exception as e:  # noqa: BLE001 — reported, not raised
+                extra[name] = {"error": repr(e)}
         record = {
             "kind": "flight_recorder",
             "reason": reason,
@@ -236,6 +259,7 @@ class FlightRecorder:
             "snapshots": snapshots,
             "final_snapshot": final,
             "spans": spans,
+            **extra,
         }
         from ..utils.atomicio import atomic_write
 

@@ -89,6 +89,36 @@ def current_span_id() -> str | None:
     return _current.get()
 
 
+def capture_context() -> str | None:
+    """Snapshot the current span parent for an explicit cross-thread
+    handoff.
+
+    ``contextvars`` do NOT cross thread boundaries: a span opened inside
+    a worker thread (the serving dispatcher, a loader thread) roots a new
+    tree even when the work is causally inside a submitting request's
+    span. The fix is an explicit handoff — the submitting thread calls
+    ``capture_context()`` and ships the value with the work item; the
+    worker wraps its processing in ``attach_context(captured)`` so spans
+    it opens parent under the submitter's span. Request tracing
+    (obs/tracing.py) uses the same capture to stamp each request's
+    ``parent_span``."""
+    return _current.get()
+
+
+@contextlib.contextmanager
+def attach_context(parent_id: str | None):
+    """Adopt a captured span context on THIS thread for the duration of
+    the block: spans opened inside parent under ``parent_id`` (from
+    ``capture_context()`` on the originating thread). Always restores the
+    previous context, even when the body raises — a worker that processes
+    many handoffs must not leak one request's context into the next."""
+    token = _current.set(parent_id)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
 def _profiler_annotation(name: str):
     """A jax.profiler.TraceAnnotation for ``name``, or a no-op when jax
     (or its profiler) is unavailable — spans must work in any process,

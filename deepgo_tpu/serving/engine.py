@@ -83,9 +83,10 @@ class EngineConfig:
 
 class _Request:
     __slots__ = ("packed", "player", "rank", "future", "t_submit", "deadline",
-                 "solo")
+                 "solo", "trace")
 
-    def __init__(self, packed, player, rank, deadline, solo=False):
+    def __init__(self, packed, player, rank, deadline, solo=False,
+                 trace=None):
         self.packed = packed
         self.player = player
         self.rank = rank
@@ -93,6 +94,7 @@ class _Request:
         self.t_submit = time.monotonic()
         self.deadline = deadline
         self.solo = solo
+        self.trace = trace  # obs.tracing.TraceContext, or None (off)
 
 
 class InferenceEngine:
@@ -253,7 +255,7 @@ class InferenceEngine:
 
     def submit(self, packed: np.ndarray, player: int, rank: int,
                timeout_s: float | None = None, block: bool = True,
-               solo: bool = False) -> Future:
+               solo: bool = False, trace=None) -> Future:
         """Queue one board; returns a Future resolving to its result row.
 
         ``timeout_s`` (default: config.timeout_s) bounds queue-to-result
@@ -263,12 +265,25 @@ class InferenceEngine:
         re-checking engine liveness so a dead dispatcher can't strand
         them. ``solo=True`` routes the request through the isolation lane:
         it dispatches strictly alone (the supervisor's batch-poison
-        bisection), skipping the bounded queue."""
+        bisection), skipping the bounded queue. ``trace`` is the caller's
+        TraceContext (obs/tracing.py) — the timeline gains queued/
+        coalesced/dispatched/resolved stamps; when tracing is armed and
+        no outer layer owns the request, the engine starts (and
+        finishes) a trace of its own."""
         self._check_alive()
+        owned = None
+        if trace is None:
+            from ..obs import tracing
+
+            trace = owned = tracing.start_request(engine=self.name)
+        if trace is not None:
+            trace.mark("queued", engine=self.name)
         timeout_s = self.config.timeout_s if timeout_s is None else timeout_s
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         req = _Request(np.asarray(packed), int(player), int(rank), deadline,
-                       solo=solo)
+                       solo=solo, trace=trace)
+        if owned is not None:
+            req.future.add_done_callback(owned.finish_future)
         if solo:
             self._solo.append(req)
             return req.future
@@ -331,6 +346,8 @@ class InferenceEngine:
         live = []
         for r in batch:
             if r.deadline is not None and now > r.deadline:
+                if r.trace is not None:
+                    r.trace.mark("expired", engine=self.name)
                 r.future.set_exception(TimeoutError(
                     f"request expired after {now - r.t_submit:.3f}s in "
                     f"InferenceEngine[{self.name}] queue"))
@@ -343,10 +360,17 @@ class InferenceEngine:
             return
         n = len(live)
         bucket = self.ladder.bucket_for(n)
+        traced = [r for r in live if r.trace is not None]
+        for r in traced:
+            r.trace.mark("coalesced", engine=self.name, batch=n,
+                         bucket=bucket)
+            r.trace.set(bucket=bucket, engine=self.name)
         packed, players, ranks = self.ladder.pad(
             np.stack([r.packed for r in live]),
             np.array([r.player for r in live], dtype=np.int32),
             np.array([r.rank for r in live], dtype=np.int32), bucket)
+        for r in traced:
+            r.trace.mark("dispatched", engine=self.name)
         t_fwd = time.monotonic()
         try:
             faults.check("serving_forward")
@@ -365,10 +389,15 @@ class InferenceEngine:
                 self._dispatch_failures += 1
             self._obs_failures.inc(engine=self.name)
             for r in live:
+                if r.trace is not None:
+                    r.trace.mark("failed", engine=self.name,
+                                 error=type(e).__name__, batch=n)
                 if not r.future.done():
                     r.future.set_exception(err)
             return
         t_done = time.monotonic()
+        for r in traced:
+            r.trace.mark("resolved", engine=self.name)
         for i, r in enumerate(live):
             r.future.set_result(out[i])
         with self._lock:

@@ -134,9 +134,10 @@ class FleetConfig:
 class _FleetRequest:
     __slots__ = ("packed", "player", "rank", "tier", "deadline", "future",
                  "excluded", "failovers", "t_submit", "t_first_failure",
-                 "last_error")
+                 "last_error", "trace")
 
-    def __init__(self, packed, player, rank, tier, deadline, t_submit):
+    def __init__(self, packed, player, rank, tier, deadline, t_submit,
+                 trace=None):
         self.packed = packed
         self.player = player
         self.rank = rank
@@ -148,6 +149,7 @@ class _FleetRequest:
         self.t_submit = t_submit
         self.t_first_failure: float | None = None
         self.last_error: BaseException | None = None
+        self.trace = trace                # one id across every hop
 
 
 class _Replica:
@@ -322,8 +324,17 @@ class FleetRouter:
                     "deadline); shed at the fleet door")
         now = self._clock()
         deadline = None if timeout_s is None else now + timeout_s
+        # the fleet door is the outermost serving layer: it owns the
+        # request's TraceContext — one trace id across every placement,
+        # failover hop, replica restart, and the final resolution
+        from ..obs import tracing
+
+        trace = tracing.start_request(fleet=self.name, tier=tier)
         req = _FleetRequest(np.asarray(packed), int(player), int(rank),
-                            tier, deadline, now)
+                            tier, deadline, now, trace=trace)
+        if trace is not None:
+            trace.mark("queued", fleet=self.name, tier=tier)
+            req.future.add_done_callback(trace.finish_future)
         self._dispatch(req, block=block)
         if req.future.done():
             exc = req.future.exception()
@@ -407,10 +418,19 @@ class FleetRouter:
                 return
             remaining = (None if req.deadline is None
                          else req.deadline - self._clock())
+            if req.trace is not None:
+                # the placement decision, stamped before the handoff so a
+                # submit-time death renders as routed -> hop
+                req.trace.mark("routed", replica=rep.idx)
+                req.trace.set(replica=rep.idx)
             try:
                 faults.check("fleet_route")
+                # the trace kwarg only travels when armed, so scripted
+                # duck-typed replicas (tests) keep their plain signature
+                kw = {} if req.trace is None else {"trace": req.trace}
                 inner = rep.engine.submit(req.packed, req.player, req.rank,
-                                          timeout_s=remaining, block=block)
+                                          timeout_s=remaining, block=block,
+                                          **kw)
             except (EngineOverloaded, CircuitOpen, EngineBusy) as e:
                 # replica-level shed: transparent reroute, no exclusion —
                 # the replica is healthy, just full (or probing)
@@ -457,6 +477,8 @@ class FleetRouter:
         req.excluded.add(rep.idx)
         req.last_error = exc
         req.failovers += 1
+        if req.trace is not None:
+            req.trace.hop(rep.idx, type(exc).__name__)
         if req.t_first_failure is None:
             req.t_first_failure = self._clock()
         with self._lock:

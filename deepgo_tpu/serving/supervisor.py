@@ -94,9 +94,9 @@ class SupervisorConfig:
 
 class _SupRequest:
     __slots__ = ("packed", "player", "rank", "deadline", "future",
-                 "solo", "solo_failures")
+                 "solo", "solo_failures", "trace")
 
-    def __init__(self, packed, player, rank, deadline):
+    def __init__(self, packed, player, rank, deadline, trace=None):
         self.packed = packed
         self.player = player
         self.rank = rank
@@ -104,6 +104,7 @@ class _SupRequest:
         self.future: Future = Future()
         self.solo = False                 # isolation-lane retry
         self.solo_failures = 0            # times it failed dispatching alone
+        self.trace = trace                # TraceContext riding every retry
 
 
 class SupervisedEngine:
@@ -248,7 +249,8 @@ class SupervisedEngine:
     # -- submission --------------------------------------------------------
 
     def submit(self, packed: np.ndarray, player: int, rank: int,
-               timeout_s: float | None = None, block: bool = True) -> Future:
+               timeout_s: float | None = None, block: bool = True,
+               trace=None) -> Future:
         """Queue one board; returns a Future that ALWAYS resolves.
 
         Outcomes: the result row (possibly after transparent engine
@@ -256,7 +258,9 @@ class SupervisedEngine:
         EngineOverloaded (admission control shed it at the door);
         CircuitOpen (breaker shedding a persistently failing engine);
         PoisonedRequest (this request fails the forward on its own);
-        EngineBusy (non-blocking submit, queue full)."""
+        EngineBusy (non-blocking submit, queue full). ``trace`` is the
+        caller's TraceContext; the SAME id rides every restart replay
+        and isolation retry (obs/tracing.py)."""
         self._check_alive()
         engine = self._engine
         if timeout_s is None:
@@ -279,15 +283,26 @@ class SupervisedEngine:
                 f"SupervisedEngine[{self.name}] circuit breaker is "
                 f"{self._breaker.state}: engine failing persistently, "
                 "shedding instead of queueing")
+        # trace creation sits BEHIND the door sheds: a shed raise is its
+        # own answer; timelines trace requests that entered the system
+        owned = None
+        if trace is None:
+            from ..obs import tracing
+
+            trace = owned = tracing.start_request(engine=self.name)
         deadline = None if timeout_s is None else self._clock() + timeout_s
         req = _SupRequest(np.asarray(packed), int(player), int(rank),
-                          deadline)
+                          deadline, trace=trace)
+        if owned is not None:
+            req.future.add_done_callback(owned.finish_future)
         try:
             self._submit_inner(req, block=block)
         except EngineBusy:
             # the breaker may have granted THE half-open probe to this
             # submit; a request that never went out must hand it back
             self._breaker.cancel_probe()
+            if owned is not None:
+                owned.finish("error", error="EngineBusy")
             raise
         return req.future
 
@@ -331,7 +346,7 @@ class SupervisedEngine:
         try:
             inner = engine.submit(req.packed, req.player, req.rank,
                                   timeout_s=remaining, block=block,
-                                  solo=req.solo)
+                                  solo=req.solo, trace=req.trace)
         except EngineBusy:
             raise
         except EngineError:
@@ -377,6 +392,9 @@ class SupervisedEngine:
                 self._declare_poison(req, exc)
             else:
                 req.solo = True  # bisect: retry strictly alone
+                if req.trace is not None:
+                    req.trace.mark("isolated", engine=self.name,
+                                   failures=req.solo_failures)
                 self._events.put(("retry", req))
         else:
             # raw error = dispatcher death (or closed under the request):
@@ -510,6 +528,8 @@ class SupervisedEngine:
             with self._lock:
                 self._replayed += 1
             self._obs_replayed.inc(engine=self.name)
+            if req.trace is not None:
+                req.trace.mark("replayed", engine=self.name)
             self._submit_inner(req, block=True)
 
     def _give_up(self, err: RestartsExhausted) -> None:

@@ -1,0 +1,584 @@
+"""Request-scoped tracing (obs/tracing.py) + the cross-thread span handoff.
+
+The load-bearing contracts:
+
+  * spans: ``capture_context``/``attach_context`` carry a parent span
+    across an explicit thread handoff (contextvars alone do not);
+  * one trace id survives a supervisor restart replay AND a fleet
+    failover, with the placement attempts recorded (routed events +
+    failover hops), and results stay bitwise identical to the untraced
+    path — observability never changes outcomes;
+  * the exemplar sampler keeps bounded memory under sustained load while
+    always retaining the slowest-k, p99+ outliers, and notable traces;
+  * kept exemplars stream as ``trace_request`` JSONL records, ride the
+    flight-recorder dump, and ``cli trace`` renders the waterfall;
+  * the lineage chain (game -> segment -> window -> gate -> champion)
+    reconstructs from the ``lineage_*`` event stream;
+  * ``cli obs`` surfaces fleet/loop sections and the exemplar table.
+"""
+
+import json
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepgo_tpu.obs import tracing
+from deepgo_tpu.obs.spans import attach_context, capture_context, span
+from deepgo_tpu.serving import (EngineConfig, FleetConfig, FleetRouter,
+                                InferenceEngine, SupervisedEngine,
+                                SupervisorConfig)
+from deepgo_tpu.utils import faults
+from deepgo_tpu.utils.metrics import MetricsWriter
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    tracing.disable_tracing()
+    yield
+    tracing.disable_tracing()
+    faults.reset()
+
+
+def ok_forward(params, packed, player, rank):
+    return np.asarray(packed, np.float32).sum(axis=(1, 2, 3)) \
+        + 1000.0 * np.asarray(player, np.float32)
+
+
+def boards(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 3, size=(n, 9, 19, 19), dtype=np.uint8),
+            rng.integers(1, 3, size=n).astype(np.int32),
+            rng.integers(1, 10, size=n).astype(np.int32))
+
+
+ECFG = EngineConfig(buckets=(1, 4), max_wait_ms=0.0)
+DIE_FAST = SupervisorConfig(max_restarts=0, backoff_base_s=0.001,
+                            backoff_cap_s=0.005)
+FAST_SUP = SupervisorConfig(backoff_base_s=0.001, backoff_cap_s=0.005)
+FAST_FLEET = FleetConfig(respawn_base_s=0.001, respawn_cap_s=0.005)
+
+
+def trace_records(sink_path):
+    out = []
+    with open(sink_path) as f:
+        for line in f:
+            r = json.loads(line)
+            if r.get("kind") == "trace_request":
+                out.append(r)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# satellite: cross-thread span parenting
+
+
+class TestSpanHandoff:
+    def test_plain_thread_detaches(self):
+        """The regression the handoff fixes: without it, a worker
+        thread's span roots a new tree."""
+        seen = []
+
+        def listener(r):
+            seen.append(r)
+
+        from deepgo_tpu.obs.spans import (add_span_listener,
+                                          remove_span_listener)
+
+        add_span_listener(listener)
+        try:
+            with span("parent"):
+                def worker():
+                    with span("child"):
+                        pass
+
+                t = threading.Thread(target=worker,
+                                     name="tracing-test-detached",
+                                     daemon=True)
+                t.start()
+                t.join()
+        finally:
+            remove_span_listener(listener)
+        child = [r for r in seen if r["name"] == "child"][0]
+        assert child["parent_id"] is None
+
+    def test_capture_attach_crosses_thread(self):
+        """The handoff: capture in the submitting thread, attach in the
+        worker — the worker's span parents under the submitter's."""
+        seen = []
+
+        def listener(r):
+            seen.append(r)
+
+        from deepgo_tpu.obs.spans import (add_span_listener,
+                                          remove_span_listener)
+
+        add_span_listener(listener)
+        try:
+            with span("parent"):
+                captured = capture_context()
+                assert captured is not None
+
+                def worker():
+                    with attach_context(captured):
+                        with span("child"):
+                            pass
+                    # context restored: a second span roots again
+                    with span("after"):
+                        pass
+
+                t = threading.Thread(target=worker,
+                                     name="tracing-test-handoff",
+                                     daemon=True)
+                t.start()
+                t.join()
+        finally:
+            remove_span_listener(listener)
+        parent = [r for r in seen if r["name"] == "parent"][0]
+        child = [r for r in seen if r["name"] == "child"][0]
+        after = [r for r in seen if r["name"] == "after"][0]
+        assert child["parent_id"] == parent["span_id"]
+        assert after["parent_id"] is None
+
+    def test_trace_context_captures_parent_span(self):
+        tracing.configure_tracing()
+        with span("submitting"):
+            from deepgo_tpu.obs.spans import current_span_id
+
+            sid = current_span_id()
+            ctx = tracing.start_request()
+        assert ctx.parent_span == sid
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+
+
+class TestRecorder:
+    def test_timeline_marks_and_idempotent_finish(self):
+        rec = tracing.TraceRecorder()
+        ctx = rec.start(tier="batch")
+        ctx.mark("queued", engine="e")
+        ctx.mark("dispatched", engine="e")
+        ctx.mark("resolved", engine="e")
+        ctx.set(bucket=4)
+        ctx.finish("ok")
+        ctx.finish("error", error="Late")  # second finish is a no-op
+        s = rec.stats()
+        assert s["started"] == s["finished"] == 1
+        assert s["errors"] == 0 and s["incomplete"] == 0
+        r = rec.exemplars()[0]
+        assert r["tier"] == "batch" and r["bucket"] == 4
+        assert [e["name"] for e in r["events"]] == [
+            "queued", "dispatched", "resolved"]
+        assert [e["t_ms"] for e in r["events"]] == \
+            sorted(e["t_ms"] for e in r["events"])
+
+    def test_incomplete_ok_timeline_counted(self):
+        rec = tracing.TraceRecorder()
+        ctx = rec.start()
+        ctx.mark("queued")
+        ctx.finish("ok")  # never dispatched/resolved
+        assert rec.stats()["incomplete"] == 1
+
+    def test_notable_traces_always_kept(self):
+        rec = tracing.TraceRecorder(slowest_k=1)
+        fast = rec.start()
+        fast.mark("queued")
+        fast.finish("ok")  # occupies the slowest-1 slot
+        hopper = rec.start()
+        hopper.hop(0, "EngineClosed")
+        hopper.finish("ok")
+        ids = {r["trace_id"] for r in rec.exemplars()}
+        assert hopper.trace_id in ids
+        assert rec.stats()["multi_hop"] == 1
+
+    def test_bounded_memory_under_sustained_load(self):
+        """50k synthetic finishes: every internal structure stays at its
+        bound, the slowest requests are retained."""
+        rec = tracing.TraceRecorder(slowest_k=4, ring_size=64,
+                                    p99_window=512, window_s=3600.0)
+        rng = np.random.default_rng(0)
+        slow_ids = []
+        for i in range(50_000):
+            ctx = rec.start()
+            ctx.mark("queued")
+            ctx.mark("dispatched")
+            ctx.mark("resolved")
+            # synthetic duration: mostly fast, occasional huge outlier
+            dur = float(rng.exponential(0.001))
+            if i % 10_000 == 9_999:
+                dur = 5.0 + i / 50_000
+                slow_ids.append(ctx.trace_id)
+            rec.record(ctx, dur, "ok", None)
+            ctx._finished = True
+        s = rec.stats()
+        assert s["finished"] == 50_000
+        assert len(rec._ring) <= 64
+        assert len(rec._durations) <= 512
+        assert len(rec._window_heap) <= 4
+        kept = {r["trace_id"] for r in rec.exemplars()}
+        # the very slowest of the run are in the ring (slowest-k window
+        # never rotated: one 3600s window)
+        assert slow_ids[-1] in kept
+
+    def test_exemplars_stream_to_sink(self, tmp_path):
+        from deepgo_tpu.obs import JsonlSink
+
+        path = tmp_path / "trace.jsonl"
+        with JsonlSink(str(path)) as sink:
+            rec = tracing.TraceRecorder(sink=sink)
+            ctx = rec.start(tier="interactive")
+            ctx.mark("queued")
+            ctx.mark("dispatched")
+            ctx.mark("resolved")
+            ctx.finish("ok")
+        records = trace_records(path)
+        assert len(records) == 1
+        assert records[0]["trace_id"] == ctx.trace_id
+        assert records[0]["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# serving-path integration
+
+
+class TestEngineTracing:
+    def test_untraced_by_default_zero_cost_path(self):
+        eng = InferenceEngine(ok_forward, None, ECFG, name="plain")
+        try:
+            packed, players, ranks = boards(3)
+            got = eng.evaluate(packed, players, ranks)
+            assert np.array_equal(got.ravel(),
+                                  ok_forward(None, packed, players,
+                                             ranks).ravel())
+        finally:
+            eng.close()
+        # nothing recorded anywhere: tracing was never armed
+        assert tracing.get_trace_recorder() is None
+
+    def test_complete_timeline_and_bitwise_parity(self):
+        rec = tracing.configure_tracing()
+        eng = InferenceEngine(ok_forward, None, ECFG, name="traced")
+        try:
+            packed, players, ranks = boards(4, seed=1)
+            got = eng.evaluate(packed, players, ranks)
+        finally:
+            eng.close()
+        tracing.disable_tracing()
+        untraced = InferenceEngine(ok_forward, None, ECFG, name="bare")
+        try:
+            again = untraced.evaluate(packed, players, ranks)
+        finally:
+            untraced.close()
+        assert np.array_equal(np.asarray(got), np.asarray(again))
+        assert np.array_equal(
+            np.asarray(got).ravel(),
+            ok_forward(None, packed, players, ranks).ravel())
+        s = rec.stats()
+        assert s["started"] == 4
+        assert s["orphans"] == 0 and s["incomplete"] == 0
+        for r in rec.exemplars():
+            names = [e["name"] for e in r["events"]]
+            for needed in ("queued", "coalesced", "dispatched", "resolved"):
+                assert needed in names, (needed, names)
+            assert r["bucket"] in (1, 4)
+
+    def test_trace_id_survives_supervisor_restart_replay(self):
+        """THE continuity contract: a dispatcher death mid-request is
+        replayed on the fresh engine under the SAME trace id, with the
+        replay visible in the timeline, and the result bitwise identical
+        to an untouched run."""
+        rec = tracing.configure_tracing()
+        faults.install("serving_dispatch:fail@2")
+        sup = SupervisedEngine(
+            lambda: InferenceEngine(ok_forward, None, ECFG, name="sup-t"),
+            config=FAST_SUP, name="sup-t")
+        try:
+            packed, players, ranks = boards(6, seed=2)
+            futs = [sup.submit(packed[i], int(players[i]), int(ranks[i]))
+                    for i in range(6)]
+            got = np.stack([np.atleast_1d(f.result(timeout=20))[0]
+                            for f in futs])
+        finally:
+            sup.close()
+        assert np.array_equal(got, ok_forward(None, packed, players, ranks))
+        s = rec.stats()
+        assert s["started"] == 6 and s["orphans"] == 0
+        assert s["incomplete"] == 0
+        replayed = [r for r in rec.exemplars()
+                    if any(e["name"] == "replayed" for e in r["events"])]
+        assert replayed, "the restart replay must appear in a timeline"
+        r = replayed[0]
+        assert r["status"] == "ok"
+        names = [e["name"] for e in r["events"]]
+        # one id, two submission legs: queued before and after the replay
+        assert names.count("queued") >= 2
+        assert names.index("replayed") < len(names) - 1
+        assert "resolved" in names
+
+    def test_trace_id_survives_fleet_failover_with_hops(self):
+        """A replica death renders as a multi-hop trace: the failed
+        placement is a hop (replica + error), the re-route a second
+        routed event — same trace id front to back, results bitwise
+        identical to the untraced forward."""
+        rec = tracing.configure_tracing()
+        faults.install("serving_dispatch:fail@2")
+
+        def make_replica(i):
+            return SupervisedEngine(
+                lambda: InferenceEngine(ok_forward, None, ECFG,
+                                        name=f"ft-rep{i}"),
+                config=DIE_FAST, name=f"ft-rep{i}")
+
+        fleet = FleetRouter(make_replica, 2, config=FAST_FLEET,
+                            name="trace-fleet", rng=random.Random(0))
+        try:
+            packed, players, ranks = boards(12, seed=3)
+            futs = [fleet.submit(packed[i], int(players[i]), int(ranks[i]),
+                                 tier="selfplay")
+                    for i in range(12)]
+            got = np.stack([np.atleast_1d(f.result(timeout=20))[0]
+                            for f in futs])
+        finally:
+            fleet.close()
+        assert np.array_equal(got, ok_forward(None, packed, players, ranks))
+        s = rec.stats()
+        assert s["started"] == 12 and s["orphans"] == 0
+        assert s["multi_hop"] >= 1
+        hopped = [r for r in rec.exemplars() if r["hops"]]
+        assert hopped
+        r = hopped[0]
+        assert r["status"] == "ok" and r["tier"] == "selfplay"
+        hop = r["hops"][0]
+        assert "replica" in hop and hop["error"]
+        names = [e["name"] for e in r["events"]]
+        # both placement attempts are on the timeline
+        assert names.count("routed") >= 2
+        assert "resolved" in names
+        # the final server is a DIFFERENT replica than the hopped one
+        routed = [e["replica"] for e in r["events"]
+                  if e["name"] == "routed"]
+        assert routed[-1] != hop["replica"]
+
+    def test_flight_dump_carries_exemplar_ring(self, tmp_path):
+        from deepgo_tpu.obs.sentinel import get_flight_recorder
+
+        flight = get_flight_recorder()
+        flight.configure(str(tmp_path))
+        try:
+            rec = tracing.configure_tracing()
+            ctx = rec.start(tier="interactive")
+            ctx.hop(1, "EngineClosed")
+            ctx.finish("error", error="FailoverExhausted")
+            path = flight.dump("test_incident")
+            assert path is not None
+            with open(path) as f:
+                dump = json.load(f)
+            section = dump["trace_exemplars"]
+            assert section["stats"]["multi_hop"] == 1
+            assert section["exemplars"][0]["trace_id"] == ctx.trace_id
+            assert section["exemplars"][0]["hops"][0]["error"] \
+                == "EngineClosed"
+        finally:
+            flight.close()
+
+
+# ---------------------------------------------------------------------------
+# offline reconstruction: cli trace + lineage
+
+
+def write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+class TestReconstruction:
+    def test_waterfall_renders_sampled_exemplar(self, tmp_path, capsys):
+        from deepgo_tpu.obs import JsonlSink
+
+        run_dir = tmp_path
+        with JsonlSink(str(run_dir / "trace.jsonl")) as sink:
+            rec = tracing.TraceRecorder(sink=sink)
+            ctx = rec.start(tier="interactive")
+            ctx.mark("queued", fleet="f")
+            ctx.mark("routed", replica=0)
+            ctx.hop(0, "RestartsExhausted")
+            ctx.mark("routed", replica=1)
+            ctx.mark("coalesced", engine="rep1", batch=3, bucket=4)
+            ctx.mark("dispatched", engine="rep1")
+            ctx.mark("resolved", engine="rep1")
+            ctx.set(bucket=4, replica=1)
+            ctx.finish("ok")
+        from deepgo_tpu.cli import main
+
+        main(["trace", str(run_dir), ctx.trace_id[:6]])
+        out = capsys.readouterr().out
+        assert f"trace {ctx.trace_id}" in out
+        assert "status=ok" in out and "hops=1" in out
+        # chronological waterfall with the hop merged in
+        import re
+
+        names = re.findall(r"\+\s*[\d.]+ms\s+(\w+)", out)
+        assert names == ["queued", "routed", "hop", "routed", "coalesced",
+                         "dispatched", "resolved"]
+
+    def test_lineage_chain_from_real_seal(self, tmp_path, capsys):
+        """The provenance walk over a REAL buffer seal record plus the
+        learner/gate/champion events keyed on one digest."""
+        from deepgo_tpu.data.dataset import META_COLS, RECORD_SHAPE
+        from deepgo_tpu.loop.replay import ReplayBuffer
+
+        run_dir = tmp_path
+        metrics = MetricsWriter(str(run_dir / "loop.jsonl"))
+        buf = ReplayBuffer(str(run_dir / "buffer"), segment_games=2,
+                           metrics=metrics)
+        rng = np.random.default_rng(0)
+        for g in range(2):
+            m = 5 + g
+            packed = rng.integers(0, 3, size=(m, *RECORD_SHAPE),
+                                  dtype=np.uint8)
+            meta = np.ones((m, META_COLS), np.int32)
+            gid = buf.ingest_game(packed, meta, winner=1,
+                                  source="actor-0")
+            metrics.write("lineage_game", gid=gid, positions=m, winner=1,
+                          source="actor-0", round=0)
+        lo, hi, version = buf.extent()
+        assert hi - lo == 11  # both games sealed
+        digest = "abcd1234" * 8
+        metrics.write("lineage_window", window=1, step0=0, step1=10,
+                      extent=[lo, hi], version=version, scheme="game",
+                      digest=digest, checkpoint="checkpoint-00000010.npz")
+        metrics.write("lineage_gate", outcome="passed", digest=digest,
+                      win_rate=0.625, games=16)
+        metrics.write("lineage_champion", digest=digest, step=10,
+                      path="champion.npz", source="gate")
+        metrics.close()
+
+        events = tracing.load_trace_events(str(run_dir))
+        chain = tracing.build_lineage(events, "champion")
+        assert chain is not None
+        assert chain["champion"]["digest"] == digest
+        assert chain["gate"]["outcome"] == "passed"
+        assert chain["window"]["extent"] == [lo, hi]
+        assert len(chain["segments"]) == 1
+        assert len(chain["games"]) == 2
+        # the digest prefix resolves the same chain
+        assert tracing.build_lineage(events, digest[:8])["window"] \
+            == chain["window"]
+        from deepgo_tpu.cli import main
+
+        main(["trace", str(run_dir), "champion"])
+        out = capsys.readouterr().out
+        assert "champion" in out and "window" in out
+        assert "games   2 ingested by actor-0 (2)" in out
+
+    def test_trace_listing_on_unknown_id(self, tmp_path, capsys):
+        write_jsonl(tmp_path / "trace.jsonl", [
+            {"kind": "trace_request", "trace_id": "feedbeef", "status": "ok",
+             "duration_s": 0.01, "hops": [], "events": []}])
+        from deepgo_tpu.cli import main
+
+        main(["trace", str(tmp_path), "nope"])
+        out = capsys.readouterr().out
+        assert "no trace or lineage matches" in out
+        assert "feedbeef" in out
+
+
+# ---------------------------------------------------------------------------
+# cli obs: fleet/loop sections + the exemplar table
+
+
+class TestReportSections:
+    def _snapshot(self):
+        def counter(series):
+            return {"kind": "counter", "help": "", "series": series}
+
+        return {"kind": "obs_snapshot", "metrics": {
+            "deepgo_fleet_failovers_total": counter({"fleet=f": 3}),
+            "deepgo_fleet_respawns_total": counter({"fleet=f": 1}),
+            "deepgo_fleet_reloads_total": counter({"fleet=f": 2}),
+            "deepgo_fleet_shed_total": counter(
+                {"fleet=f,reason=admission,tier=batch": 4}),
+            "deepgo_serving_restarts_total": counter(
+                {"engine=rep0": 2, "engine=rep1": 1}),
+            "deepgo_loop_games_ingested_total": counter({"": 40}),
+            "deepgo_loop_windows_trained_total": counter({"": 3}),
+            "deepgo_loop_gates_passed_total": counter({"": 1}),
+            "deepgo_loop_component_restarts_total": counter(
+                {"component=actor": 2}),
+            "deepgo_loop_learner_step": {
+                "kind": "gauge", "help": "", "series": {"": 150.0}},
+        }}
+
+    def test_fleet_and_loop_sections(self, tmp_path):
+        from deepgo_tpu.obs.report import summarize_run
+
+        write_jsonl(tmp_path / "metrics.jsonl", [self._snapshot()])
+        write_jsonl(tmp_path / "loop.jsonl", [
+            {"kind": "fleet_respawn", "fleet": "f", "replica": 1,
+             "attempt": 1, "total_respawns": 1},
+            {"kind": "loop_restart", "component": "actor-0", "attempt": 1,
+             "error": "x"},
+            {"kind": "loop_close", "games_acked": 40, "games_durable": 40,
+             "champion_step": 150},
+        ])
+        s = summarize_run(str(tmp_path))
+        fleet = s["events"]["fleet"]
+        assert fleet["failovers"] == 3
+        assert fleet["respawns"] == 1
+        assert fleet["reloads"] == 2
+        assert fleet["shed"] == {"fleet=f,reason=admission,tier=batch": 4}
+        assert fleet["replica_restarts"] == {"engine=rep0": 2,
+                                             "engine=rep1": 1}
+        assert fleet["respawns_by_replica"] == {"1": 1}
+        loop = s["events"]["loop"]
+        assert loop["games_ingested"] == 40
+        assert loop["windows_trained"] == 3
+        assert loop["gates_passed"] == 1
+        assert loop["component_restarts"] == {"component=actor": 2}
+        assert loop["learner_step"] == 150
+        assert loop["games_durable"] == 40
+
+    def test_exemplar_table(self, tmp_path):
+        from deepgo_tpu.obs.report import format_report, summarize_run
+
+        write_jsonl(tmp_path / "trace.jsonl", [
+            {"kind": "trace_request", "trace_id": f"id{i:02d}",
+             "status": "ok", "tier": "interactive", "replica": i % 2,
+             "bucket": 4, "duration_s": 0.001 * (i + 1),
+             "hops": [{"replica": 0, "error": "EngineClosed",
+                       "t_ms": 1.0}] if i == 11 else [],
+             "events": [{"name": "queued", "t_ms": 0.0}]}
+            for i in range(12)])
+        s = summarize_run(str(tmp_path))
+        ex = s["exemplars"]
+        assert len(ex) == 10  # top-10 of 12
+        assert ex[0]["trace_id"] == "id11"  # slowest first
+        assert ex[0]["hops"] == 1
+        rendered = format_report(s)
+        assert "slowest requests" in rendered
+        assert "id11" in rendered
+
+    def test_loop_sections_without_snapshot(self, tmp_path):
+        """A loop run has no obs_snapshot: the sections build from the
+        event stream alone."""
+        from deepgo_tpu.obs.report import summarize_run
+
+        write_jsonl(tmp_path / "loop.jsonl", [
+            {"kind": "loop_ingest", "gid": 0, "positions": 9, "winner": 1,
+             "source": "actor-0"},
+            {"kind": "loop_window", "window": 1, "step0": 0, "step1": 50},
+            {"kind": "loop_gate", "outcome": "passed", "win_rate": 0.6},
+        ])
+        s = summarize_run(str(tmp_path))
+        loop = s["events"]["loop"]
+        assert loop["games_ingested"] == 1
+        assert loop["windows_trained"] == 1
+        assert loop["gates_passed"] == 1
