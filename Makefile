@@ -2,8 +2,8 @@
 
 .PHONY: native data test test-full lint verify verify-faults verify-serving \
     verify-resilience verify-fleet verify-distributed verify-obs \
-    verify-slo verify-trace verify-loop verify-analysis bench bench-gate \
-    smoke clean
+    verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
+    bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -57,7 +57,10 @@ verify-analysis:  # invariant linter fixtures + clean-tree run + lock-order sani
 	JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py \
 	    tests/test_lockcheck.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis  # the full failure-model suite
+verify-xlacheck:  # XLA-contract sanitizer: recompile sentinel (live storm), transfer guard, sharding claims, bench gate fold
+	JAX_PLATFORMS=cpu python -m pytest tests/test_xlacheck.py -q
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -134,9 +134,22 @@ def _record_last_good(result: dict) -> None:
 
     Keyed by metric so --mode train/latency/large each keep their own
     record. Only ever called for real-device results (a CPU smoke run
-    must not overwrite a TPU measurement with a CPU number)."""
+    must not overwrite a TPU measurement with a CPU number) — and it
+    REFUSES stale/errored results and captures whose relay probe was not
+    live (rounds r3–r5 silently recorded wedged-probe values; the probe
+    block now rides in every BENCH json so staleness is auditable)."""
+    import sys
+
     from deepgo_tpu.utils import gitinfo
 
+    probe = result.get("probe")
+    if result.get("stale") or result.get("error") or (
+            isinstance(probe, dict) and probe.get("live") is False):
+        print("bench: refusing to record last-good from a "
+              "stale/errored/dead-probe capture "
+              f"(stale={result.get('stale')}, error={result.get('error')!r}, "
+              f"probe={probe})", file=sys.stderr, flush=True)
+        return
     try:
         with open(LAST_GOOD_PATH) as f:
             table = json.load(f)
@@ -222,8 +235,9 @@ def _arm_watchdog(mode: str = "inference"):
     )
 
 
-def _preflight_probe(mode: str = "inference") -> None:
+def _preflight_probe(mode: str = "inference") -> dict:
     """Claim-and-release the device in a child with a short timeout.
+    Returns the probe-liveness record stamped into the BENCH json.
 
     A wedged relay then fails the bench in seconds (with a parseable JSON
     line), not at the 900s watchdog / driver timeout. The child inherits
@@ -243,7 +257,7 @@ def _preflight_probe(mode: str = "inference") -> None:
     import sys
 
     if os.environ.get("BENCH_PREFLIGHT") == "0":
-        return
+        return {"live": None, "skipped": True}
     # defaults keep the WORST failure path at 360s (3 x 60s canaries +
     # 60/120s backoffs) — exactly the failure envelope the round-4 driver
     # demonstrably waited out (BENCH_r04.json: 3 x 60s probes + 2 x 60s
@@ -268,6 +282,7 @@ def _preflight_probe(mode: str = "inference") -> None:
             "print(jax.devices()[0].platform, v, flush=True)")
     last_error = "pre-flight device probe never ran"
     for attempt in range(1, tries + 1):
+        t0 = time.time()
         try:
             r = subprocess.run([sys.executable, "-c", code],
                                capture_output=True, text=True,
@@ -278,7 +293,15 @@ def _preflight_probe(mode: str = "inference") -> None:
                           "(TPU relay claim likely wedged)")
         else:
             if r.returncode == 0:
-                return
+                out = r.stdout.split()
+                return {
+                    "live": True,
+                    "attempts": attempt,
+                    "probe_s": round(time.time() - t0, 3),
+                    "platform": out[0] if out else None,
+                    "checked_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime()),
+                }
             last_error = (f"pre-flight compute canary failed on attempt "
                           f"{attempt}/{tries}: " + r.stderr[-400:].strip())
         if attempt < tries:
@@ -292,10 +315,18 @@ def _preflight_probe(mode: str = "inference") -> None:
     # a stale-but-real line is a valid degraded measurement (exit 0 so
     # drivers that gate on rc still take the parsed value); only the
     # nothing-ever-measured case is a hard failure. Exit code derives
-    # from the actual printed line so the two can never disagree.
-    line = _diagnostic_json(last_error, mode)
-    print(line, flush=True)
-    raise SystemExit(0 if json.loads(line).get("stale") else 1)
+    # from the actual printed line so the two can never disagree. The
+    # probe block rides in the line so the driver can SEE the capture
+    # came from a dead relay — and _record_last_good refuses it.
+    out = json.loads(_diagnostic_json(last_error, mode))
+    out["probe"] = {
+        "live": False,
+        "attempts": tries,
+        "error": last_error[:300],
+        "checked_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(out), flush=True)
+    raise SystemExit(0 if out.get("stale") else 1)
 
 
 def _conv_flops_per_sample(cfg) -> float:
@@ -544,6 +575,20 @@ def _apply_gate(result: dict, args) -> None:
         entry = None
     result["gate"] = evaluate_gate(
         result, entry, GateConfig(threshold=args.gate))
+    # the zero-recompile sentinel folds INTO the gate verdict: a run
+    # whose engines compiled post-warmup fails the gate even when raw
+    # throughput passed — a recompile storm is a latent 10x regression
+    # waiting for the next shape mix (docs/static_analysis.md)
+    xla = result.get("xlacheck")
+    if xla is not None:
+        ssc = xla.get("steady_state_compiles", 0)
+        result["gate"]["steady_state_compiles"] = ssc
+        if ssc and result["gate"].get("verdict") != "fail":
+            result["gate"].update(
+                verdict="fail",
+                reason=f"{ssc} steady-state compile(s) post-warmup — the "
+                       "zero-recompile contract is broken "
+                       f"(was: {result['gate'].get('reason')})")
 
 
 def _exit_gate(result: dict, args) -> None:
@@ -759,8 +804,10 @@ def _bench_loop(on_tpu: bool, faults_spec: str | None = None) -> dict:
         from deepgo_tpu.utils import faults as faults_mod
 
         faults_mod.install(faults_spec)
-        # chaos soak = race hunt (docs/static_analysis.md)
+        # chaos soak = race hunt + XLA-contract audit
+        # (docs/static_analysis.md)
         os.environ.setdefault("DEEPGO_LOCKCHECK", "1")
+        os.environ.setdefault("DEEPGO_XLACHECK", "1")
     windows = 3
     cfg = LoopConfig(
         actors=2, fleet=2, games_per_round=3, max_moves=24,
@@ -816,19 +863,35 @@ def _bench_loop(on_tpu: bool, faults_spec: str | None = None) -> dict:
             "fleet_reloads": summary["fleet_reloads"],
             "seconds": round(dt, 2),
         }
-        from deepgo_tpu.analysis import lockcheck
+        from deepgo_tpu.analysis import lockcheck, xlacheck
 
         if lockcheck.enabled():
             lrep = lockcheck.report()
             result["lockcheck"] = {"locks": len(lrep["locks"]),
                                    "cycles": len(lrep["cycles"]),
                                    "hazards": len(lrep["hazards"])}
+        if xlacheck.enabled():
+            xrep = xlacheck.report()
+            result["xlacheck"] = {
+                "watched": len(xrep["watched"]),
+                "steady_state_compiles": xrep["steady_state_compiles"],
+                "transfer_violations": len(xrep["transfers"]),
+                "sharding_mismatches": len(xrep["sharding"]),
+            }
         if faults_spec:
             result["faults"] = faults_spec
         errors = []
         if result.get("lockcheck", {}).get("cycles"):
             errors.append(f"{result['lockcheck']['cycles']} lock-order "
                           "cycle(s) detected")
+        xla = result.get("xlacheck", {})
+        if xla.get("steady_state_compiles"):
+            errors.append(f"{xla['steady_state_compiles']} steady-state "
+                          "compile(s) post-warmup")
+        if xla.get("transfer_violations") or xla.get("sharding_mismatches"):
+            errors.append("xlacheck transfer/sharding finding(s): "
+                          f"{xla['transfer_violations']} transfer, "
+                          f"{xla['sharding_mismatches']} sharding")
         if lost != 0:
             errors.append(f"{lost} acked game(s) not durable")
         if mismatches:
@@ -986,6 +1049,10 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         # sanitizer instruments engine/supervisor/fleet/obs locks created
         # from here on (docs/static_analysis.md); cycles land in the JSON
         os.environ.setdefault("DEEPGO_LOCKCHECK", "1")
+        # ... and as an XLA-contract audit: the recompile sentinel,
+        # transfer guard, and sharding-claim checker arm with the
+        # engines built below; any finding lands as an error
+        os.environ.setdefault("DEEPGO_XLACHECK", "1")
     if fleet:
         sup = (SupervisorConfig(max_restarts=0, backoff_base_s=0.01,
                                 backoff_cap_s=0.1)
@@ -1151,6 +1218,29 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         if lrep["cycles"]:
             errors.append(f"{len(lrep['cycles'])} lock-order cycle(s) "
                           "detected")
+    from deepgo_tpu.analysis import xlacheck
+
+    xlacheck_report = None
+    if xlacheck.enabled():
+        xrep = xlacheck.report()
+        xlacheck_report = {
+            "watched": len(xrep["watched"]),
+            "steady_state_compiles": xrep["steady_state_compiles"],
+            "transfer_violations": len(xrep["transfers"]),
+            "sharding_mismatches": len(xrep["sharding"]),
+        }
+        for storm in xrep["storms"]:
+            print(f"bench: RECOMPILE STORM {storm['fn']} shapes "
+                  f"{storm['shapes']}", file=sys.stderr, flush=True)
+        if xrep["steady_state_compiles"]:
+            errors.append(f"{xrep['steady_state_compiles']} steady-state "
+                          "compile(s) post-warmup")
+        if xrep["transfers"]:
+            errors.append(f"{len(xrep['transfers'])} implicit "
+                          "host<->device transfer(s) in guarded sections")
+        if xrep["sharding"]:
+            errors.append(f"{len(xrep['sharding'])} sharding-claim "
+                          "mismatch(es)")
     goodput = outcomes["ok"] / dt
     # tracing accounting: started == finished (no orphan ids) and every
     # ok timeline carries queued/dispatched/resolved; the chaos kill
@@ -1215,6 +1305,8 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         }
         if lockcheck_report is not None:
             result["lockcheck"] = lockcheck_report
+        if xlacheck_report is not None:
+            result["xlacheck"] = xlacheck_report
         if faults_spec:
             result["faults"] = faults_spec
         if healthz_codes:
@@ -1251,6 +1343,8 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
             })
         if lockcheck_report is not None:
             result["lockcheck"] = lockcheck_report
+        if xlacheck_report is not None:
+            result["xlacheck"] = xlacheck_report
     result["tracing"] = tracing_block
     if errors:
         result["error"] = "; ".join(sorted(set(errors))[:3])
@@ -1340,7 +1434,7 @@ def main() -> None:
         _exit_gate(result, args)
         return
 
-    _preflight_probe(args.mode)
+    probe = _preflight_probe(args.mode)
     watchdog = _arm_watchdog(args.mode)
     # honor JAX_PLATFORMS (e.g. a CPU smoke run) against the terminal
     # sitecustomize's override — without this a CPU-pinned bench still
@@ -1369,6 +1463,7 @@ def main() -> None:
                   "large": _bench_large}[args.mode]
             result = fn(on_tpu)
         result["device"] = str(device)
+        result["probe"] = probe
         watchdog.disarm()
         if on_tpu and result.get("value"):
             _record_last_good(result)
@@ -1424,6 +1519,7 @@ def main() -> None:
         # gate widens its threshold by this (noise-aware gating)
         "noise_frac": round((max(times) - min(times)) / dt, 4)
         if len(times) > 1 else 0.0,
+        "probe": probe,
     }
     if on_tpu:
         _record_last_good(result)

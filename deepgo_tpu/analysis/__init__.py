@@ -13,11 +13,18 @@ errors, documented grammar) that review alone cannot hold at scale.
     acquisition graph across the dispatcher/supervisor/fleet/replay/obs
     threads and report order-inversion cycles and long-hold hazards
     through the flight recorder.
+  * :mod:`xlacheck` — the opt-in (``DEEPGO_XLACHECK=1``) runtime XLA
+    performance-contract sanitizer: the recompile sentinel (zero
+    post-warmup compile budget, typed ``RecompileStorm`` findings with
+    the triggering abstract shapes), the implicit-transfer guard, and
+    the sharding-claim checker. Its static twin is the linter's
+    jit-boundary/hot-sync/donation/constant-upload rules.
 
-Only :mod:`lockcheck` is imported eagerly — it is on the production lock
-construction path and must stay stdlib-only; the linter halves load on
-demand from the CLI and tests.
+Only :mod:`lockcheck` and :mod:`xlacheck` are imported eagerly — they
+sit on production construction paths and stay import-light (no jax at
+import time); the linter halves load on demand from the CLI and tests.
 """
 
+from . import xlacheck  # noqa: F401
 from .lockcheck import enabled as lockcheck_enabled  # noqa: F401
 from .lockcheck import make_lock, make_rlock  # noqa: F401

@@ -25,7 +25,10 @@ import re
 PRAGMA_RE = re.compile(r"#\s*lint:\s*allow\[([a-z-]+)\]\s*(.*?)\s*$")
 
 RULES = ("atomic-write", "determinism", "thread-discipline",
-         "typed-error", "grammar-drift", "pragma")
+         "typed-error", "grammar-drift", "pragma",
+         # the XLA performance-contract rules (ISSUE 11; the dynamic
+         # half lives in analysis/xlacheck.py)
+         "jit-boundary", "hot-sync", "donation", "constant-upload")
 
 # np.random entry points that create explicitly-seeded, owned streams —
 # everything else on np.random is hidden global state
@@ -67,6 +70,32 @@ class LintConfig:
         "deepgo_tpu/experiments/",
         "deepgo_tpu/analysis/",
         "deepgo_tpu/data/loader.py",
+    )
+
+    # hot-sync: (file, top-level function) scopes where a host<->device
+    # sync (np.asarray / .item() / block_until_ready / device_get /
+    # float(<forward call>)) stalls a dispatcher thread, a train-step
+    # loop, or a per-request path. Syncs there are legal only at the
+    # DECLARED materialization points, pragma'd with a reason
+    # (docs/static_analysis.md). Explicit-path mode treats every
+    # function as hot (fixture testing).
+    hot_sync_scope: tuple = (
+        ("deepgo_tpu/serving/engine.py", "_dispatch"),
+        ("deepgo_tpu/serving/engine.py", "_dispatch_loop"),
+        ("deepgo_tpu/serving/engine.py", "_collect"),
+        ("deepgo_tpu/serving/fleet.py", "_dispatch"),
+        ("deepgo_tpu/serving/fleet.py", "_router_loop"),
+        ("deepgo_tpu/loop/learner.py", "train_window"),
+        ("deepgo_tpu/experiments/experiment.py", "_train"),
+    )
+
+    # jit-boundary: (file, function) bodies that execute under trace
+    # even though no decorator says so at the def site (helpers called
+    # from inside jitted steps) — module/instance-state reads there are
+    # baked into compiled programs exactly like in a decorated jit
+    traced_scope: tuple = (
+        ("deepgo_tpu/ops/augment.py", "augment_batch"),
+        ("deepgo_tpu/training/steps.py", "_one_step"),
     )
 
     # grammar drift: the docs that hold the authoritative metric/event/

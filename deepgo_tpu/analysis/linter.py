@@ -30,6 +30,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import os
+import re
 
 from .config import NP_RANDOM_OK, PRAGMA_RE, RULES, LintConfig
 
@@ -41,6 +42,15 @@ _HINTS = {
     "typed-error": "raise a typed error (survives `python -O`); "
                    "catch specific exceptions",
     "pragma": "pragmas need a reason: # lint: allow[RULE] why",
+    "jit-boundary": "pass the state as an argument (or mark the scalar "
+                    "static_argnames=); traced closures bake mutable "
+                    "state at compile time",
+    "hot-sync": "keep device values on device; materialize once at the "
+                "declared point (pragma it with the reason)",
+    "donation": "add donate_argnums to step-shaped jits; never read a "
+                "donated buffer after the call",
+    "constant-upload": "hoist jnp.asarray(CONST) out of the per-call fn "
+                       "(factory scope / closure)",
 }
 
 
@@ -195,6 +205,381 @@ class _FileChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# module-constant naming: what constant-upload treats as a hoistable table
+_CONST_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+# jnp entry points that upload a host constant to the device
+_JNP_UPLOAD = ("jnp.asarray", "jnp.array", "jax.numpy.asarray",
+               "jax.numpy.array")
+
+# module-level call results the mutable-state scan treats as immutable
+_IMMUTABLE_CALLS = ("frozenset", "tuple", "property", "re.compile",
+                    "collections.namedtuple", "namedtuple")
+
+# host-side numeric namespaces float() may materialize from without a sync
+_HOST_FLOAT_OK = ("np.", "numpy.", "math.", "len", "round", "int", "str",
+                  "min", "max", "sum", "abs")
+
+
+def _jit_decorator_info(dec) -> dict | None:
+    """{"donate": bool, "static": bool} when ``dec`` is a jit decorator
+    (bare ``jax.jit``, ``jax.jit(...)``, or ``functools.partial(jax.jit,
+    ...)``); None otherwise."""
+    if _dotted(dec) in ("jax.jit", "jit"):
+        return {"donate": False, "static": False}
+    if isinstance(dec, ast.Call):
+        f = _dotted(dec.func)
+        kws = {kw.arg for kw in dec.keywords}
+        if f in ("jax.jit", "jit"):
+            pass
+        elif f in ("functools.partial", "partial") and dec.args \
+                and _dotted(dec.args[0]) in ("jax.jit", "jit"):
+            pass
+        else:
+            return None
+        return {
+            "donate": bool(kws & {"donate_argnums", "donate_argnames"}),
+            "static": bool(kws & {"static_argnums", "static_argnames"}),
+        }
+    return None
+
+
+def _donate_indices(call: ast.Call) -> tuple[int, ...]:
+    """The literal donate_argnums of a ``jax.jit(fn, donate_argnums=...)``
+    call, () when absent or not statically literal."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+            return tuple(out)
+    return ()
+
+
+def _assign_target_names(node) -> set[str]:
+    names: set[str] = set()
+    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+    return names
+
+
+def _scoped_walk(fn):
+    """Every node lexically inside ``fn`` EXCLUDING nested function
+    subtrees (those get their own `_check_function` pass, with inherited
+    jit/hot context)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _child_functions(fn):
+    """Function defs whose nearest enclosing function is ``fn``."""
+    out = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(node)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class _XlaChecker:
+    """The XLA performance-contract rules (the static half; the runtime
+    half is analysis/xlacheck.py — docs/static_analysis.md):
+
+      * ``jit-boundary`` — a jitted/shard_map'd/traced function reading
+        ``self.<attr>`` or a module-level mutable array/container bakes
+        that state into the compiled program at trace time (a later
+        mutation silently serves stale values or forces a recompile);
+        a str/bool-defaulted parameter on a plain jit is traced per
+        call instead of marked static.
+      * ``hot-sync`` — ``np.asarray`` / ``.item()`` /
+        ``block_until_ready`` / ``device_get`` / ``float(<call>)`` in a
+        dispatcher thread, train-step loop, or per-request path stalls
+        the pipeline on a device round-trip; legal only at the declared
+        materialization points (reasoned pragmas).
+      * ``donation`` — a step-shaped jit (params + opt_state, or a
+        ``*step`` taking params) missing ``donate_argnums`` doubles the
+        parameter working set; a donated buffer read after the call is
+        garbage.
+      * ``constant-upload`` — ``jnp.asarray(MODULE_CONST)`` inside a
+        per-call fn re-uploads (or re-bakes) the constant; hoist it to
+        factory scope.
+    """
+
+    def __init__(self, rel: str, config: LintConfig):
+        self.rel = rel
+        self.config = config
+        self.findings: list[Finding] = []
+        self._hot_fns = {fn for path, fn in config.hot_sync_scope
+                         if path == rel}
+        self._traced_fns = {fn for path, fn in config.traced_scope
+                            if path == rel}
+
+    def _add(self, rule: str, node, message: str) -> None:
+        self.findings.append(Finding(rule, self.rel, node.lineno,
+                                     "strict", message))
+
+    # -- module scan -------------------------------------------------------
+
+    def _scan_module(self, tree: ast.Module) -> None:
+        """Module-level mutable names + jit/shard_map wrap-assignments +
+        the per-name donation map."""
+        self.module_mutable: set[str] = set()
+        self.wrapped_traced: set[str] = set()   # defs jitted/mapped by name
+        self.donating: dict[str, tuple[int, ...]] = {}
+        for stmt in tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            value = stmt.value
+            mutable = isinstance(value, (
+                ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                ast.SetComp))
+            if isinstance(value, ast.Call) \
+                    and _dotted(value.func) not in _IMMUTABLE_CALLS:
+                mutable = True
+            if mutable:
+                self.module_mutable |= _assign_target_names(stmt)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    info = _jit_decorator_info(dec)
+                    if info and info["donate"]:
+                        self.donating.setdefault(node.name, self._dec_donate(
+                            node.decorator_list))
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            f = _dotted(call.func)
+            first = call.args[0] if call.args else None
+            if not isinstance(first, ast.Name):
+                continue
+            if f in ("jax.jit", "jit"):
+                self.wrapped_traced.add(first.id)
+                idx = _donate_indices(call)
+                if idx:
+                    for name in _assign_target_names(node):
+                        self.donating[name] = idx
+            elif f.rsplit(".", 1)[-1] in ("shard_map", "_wrap_shard_map"):
+                self.wrapped_traced.add(first.id)
+
+    @staticmethod
+    def _dec_donate(decorators) -> tuple[int, ...]:
+        for dec in decorators:
+            if isinstance(dec, ast.Call):
+                idx = _donate_indices(dec)
+                if idx:
+                    return idx
+                for inner in dec.args:
+                    if isinstance(inner, ast.Call):
+                        idx = _donate_indices(inner)
+                        if idx:
+                            return idx
+        return ()
+
+    # -- the walk ----------------------------------------------------------
+
+    def check(self, tree: ast.Module) -> None:
+        self._scan_module(tree)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(stmt, in_jit=False,
+                                     hot=self._is_hot(stmt.name))
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self._check_function(sub, in_jit=False,
+                                             hot=self._is_hot(sub.name))
+
+    def _is_hot(self, name: str) -> bool:
+        return self.config.all_scopes or name in self._hot_fns
+
+    @staticmethod
+    def _param_names(fn) -> list[str]:
+        a = fn.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def _check_function(self, fn, in_jit: bool, hot: bool) -> None:
+        info = None
+        for dec in fn.decorator_list:
+            info = _jit_decorator_info(dec)
+            if info is not None:
+                break
+        traced_here = (info is not None or fn.name in self.wrapped_traced
+                       or fn.name in self._traced_fns)
+        now_jit = in_jit or traced_here
+        params = self._param_names(fn)
+        if info is not None:
+            self._check_jit_signature(fn, info, params)
+        if now_jit:
+            self._check_jit_body(fn, params)
+        children = _child_functions(fn)
+        # a function that builds nested defs is a factory: its OWN scope
+        # is the hoist target ("upload once, close over the device
+        # array"), so constant-upload only binds in leaf/jitted scopes
+        self._check_calls(fn, hot=hot, in_jit=now_jit,
+                          factory=bool(children) and not now_jit)
+        self._check_donated_reuse(fn)
+        for sub in children:
+            self._check_function(sub, in_jit=now_jit, hot=hot)
+
+    # -- jit-boundary ------------------------------------------------------
+
+    def _check_jit_signature(self, fn, info: dict, params: list[str]) -> None:
+        if not info["static"]:
+            defaults = list(fn.args.defaults) + list(fn.args.kw_defaults)
+            for d in defaults:
+                if isinstance(d, ast.Constant) \
+                        and isinstance(d.value, (str, bool)):
+                    self._add("jit-boundary", fn,
+                              f"jitted {fn.name}() takes a Python "
+                              f"{type(d.value).__name__}-default parameter "
+                              "without static_argnames — each distinct "
+                              "value is a silent retrace (or a trace-time "
+                              "error)")
+                    break
+        if info["donate"]:
+            return
+        step_shaped = ("params" in params and "opt_state" in params) or (
+            (fn.name == "step" or fn.name.endswith("_step"))
+            and "params" in params)
+        if step_shaped:
+            self._add("donation", fn,
+                      f"step-shaped jit {fn.name}() missing donate_argnums "
+                      "— the update holds old and new buffers live "
+                      "(double the parameter working set)")
+
+    def _check_jit_body(self, fn, params: list[str]) -> None:
+        for node in _scoped_walk(fn):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                self._add("jit-boundary", node,
+                          f"jitted/traced code reads self.{node.attr} — "
+                          "mutable instance state is baked at trace time "
+                          "(a later mutation silently serves stale "
+                          "values); pass it as an argument")
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in self.module_mutable \
+                    and node.id not in params:
+                self._add("jit-boundary", node,
+                          f"jitted/traced code reads module-level mutable "
+                          f"state {node.id!r} — baked per compile; an "
+                          "in-place mutation silently invalidates every "
+                          "compiled program")
+
+    # -- hot-sync + constant-upload ----------------------------------------
+
+    def _check_calls(self, fn, hot: bool, in_jit: bool,
+                     factory: bool = False) -> None:
+        for node in _scoped_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if hot:
+                self._check_hot_call(node, dotted)
+            if not factory and dotted in _JNP_UPLOAD and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and _CONST_RE.match(node.args[0].id) \
+                    and not (in_jit
+                             and node.args[0].id in self.module_mutable):
+                self._add("constant-upload", node,
+                          f"jnp upload of module constant "
+                          f"{node.args[0].id!r} inside a per-call fn — "
+                          "hoist to factory scope so it transfers once")
+
+    def _check_hot_call(self, node: ast.Call, dotted: str) -> None:
+        if dotted in ("np.asarray", "numpy.asarray"):
+            self._add("hot-sync", node,
+                      "np.asarray on a device value in a hot path blocks "
+                      "the thread on a d2h transfer")
+        elif dotted in ("jax.block_until_ready", "jax.device_get"):
+            self._add("hot-sync", node,
+                      f"{dotted}() in a hot path stalls the dispatch "
+                      "pipeline on the device")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "block_until_ready") \
+                and not node.args:
+            self._add("hot-sync", node,
+                      f".{node.func.attr}() in a hot path is a host<->"
+                      "device sync per call")
+        elif isinstance(node.func, ast.Name) and node.func.id == "float" \
+                and len(node.args) == 1 and isinstance(node.args[0],
+                                                       ast.Call):
+            inner = _dotted(node.args[0].func)
+            if inner and not inner.startswith(_HOST_FLOAT_OK):
+                self._add("hot-sync", node,
+                          f"float({inner}(...)) materializes a device "
+                          "value per call in a hot path")
+
+    # -- donated-buffer reuse ----------------------------------------------
+
+    def _check_donated_reuse(self, fn) -> None:
+        if not self.donating:
+            return
+        # local donating wrappers shadow/extend the module map
+        donating = dict(self.donating)
+        for node in _scoped_walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and _dotted(node.value.func) in ("jax.jit", "jit"):
+                idx = _donate_indices(node.value)
+                if idx:
+                    for name in _assign_target_names(node):
+                        donating[name] = idx
+        # every assignment line per name (rebinds end a donation hazard)
+        assigns: dict[str, list[int]] = {}
+        for node in _scoped_walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                for name in _assign_target_names(node):
+                    assigns.setdefault(name, []).append(node.lineno)
+            elif isinstance(node, ast.For):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        assigns.setdefault(sub.id, []).append(node.lineno)
+        # donated positional args, then later un-rebound reads
+        for node in _scoped_walk(fn):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Name) \
+                    or node.func.id not in donating:
+                continue
+            call_line = node.lineno
+            donated = [node.args[i].id for i in donating[node.func.id]
+                       if i < len(node.args)
+                       and isinstance(node.args[i], ast.Name)]
+            for name in donated:
+                for read in _scoped_walk(fn):
+                    if isinstance(read, ast.Name) and read.id == name \
+                            and isinstance(read.ctx, ast.Load) \
+                            and read.lineno > call_line \
+                            and not any(call_line <= a <= read.lineno
+                                        for a in assigns.get(name, ())):
+                        self._add("donation", read,
+                                  f"donated buffer {name!r} read after "
+                                  f"the donating call at line {call_line} "
+                                  "— its memory was handed to XLA")
+                        break
+
+
 def _collect_pragmas(rel: str, source: str) -> tuple[dict, list[Finding]]:
     """line -> (rule, reason) for every pragma; malformed ones (missing
     reason, unknown rule) are findings themselves."""
@@ -229,6 +614,9 @@ def lint_file(path: str, rel: str, config: LintConfig) -> list[Finding]:
         return findings
     checker = _FileChecker(rel, source, config)
     checker.visit(tree)
+    xla = _XlaChecker(rel, config)
+    xla.check(tree)
+    checker.findings.extend(xla.findings)
     lines = source.splitlines()
     for f_ in checker.findings:
         allowed = False

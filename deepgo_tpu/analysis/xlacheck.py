@@ -1,0 +1,399 @@
+"""Runtime XLA performance-contract sanitizer (``DEEPGO_XLACHECK=1``).
+
+Every speed story in this repo rests on hand-enforced XLA contracts: the
+bucket ladder's "zero steady-state compiles" (serving/buckets.py, the
+FireCaffe discipline), donated step buffers (training/steps.py), and
+named-mesh shardings that must not silently fall back to full
+replication (parallel/tensor.py, zero.py — the failure mode
+arXiv:2004.13336 exists to prevent). The static half of this contract
+lives in the linter (``jit-boundary`` / ``hot-sync`` / ``donation`` /
+``constant-upload`` rules, analysis/linter.py); this module is the
+dynamic half — the lockcheck pattern applied to XLA:
+
+  * **recompile sentinel** — :func:`watch_compiles` wraps a jitted
+    forward with a per-function compile counter (the engine's existing
+    ``compile_cache_size`` plumbing, read before/after every call).
+    :func:`mark_warm` at the warmup boundary sets the budget to ZERO:
+    any later compile is a steady-state compile, recorded as a typed
+    :class:`RecompileStorm` finding carrying the triggering abstract
+    shapes and dumped through the obs flight recorder — the postmortem
+    names the exact shape that broke the ladder.
+  * **transfer guard** — :func:`transfer_guard` wraps hot sections in
+    ``jax.transfer_guard("disallow")`` so an implicit h2d/d2h raises at
+    the exact line; :func:`stage_h2d` is the explicit ``device_put``
+    for DECLARED transfer points (the engine's dispatch stages its
+    padded batch through it). Violations are counted and recorded on
+    their way out.
+  * **sharding-claim checker** — :func:`check_sharding` verifies a
+    declared sharding pytree against the ``.sharding`` of live arrays,
+    so "sharded" can never silently mean "replicated" again. Wired into
+    the placement paths (``tensor.shard_params`` /
+    ``zero.shard_opt_state``) on their dryrun/real runs alike.
+
+Opt-in like lockcheck: everything here is a no-op (identity-returning,
+``nullcontext``) unless ``DEEPGO_XLACHECK=1`` (or programmatic
+:func:`enable`), so the hot paths pay nothing by default — the only
+always-on cost is one attribute check per engine dispatch.
+``bench.py --mode serving|loop --faults`` arms it automatically and any
+finding lands in the bench JSON as an error; ``bench --gate`` folds a
+``steady_state_compiles == 0`` sentinel into its verdict.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import sys
+import threading
+import time
+
+_ENV = "DEEPGO_XLACHECK"
+_force: bool | None = None
+
+
+def enabled() -> bool:
+    """Is the sanitizer on? Programmatic :func:`enable` wins over the
+    ``DEEPGO_XLACHECK`` environment variable."""
+    if _force is not None:
+        return _force
+    return os.environ.get(_ENV, "0") not in ("", "0")
+
+
+def enable(on: bool | None = True) -> None:
+    """Programmatic override (tests, bench). ``enable(None)`` restores
+    environment-variable control."""
+    global _force
+    _force = on
+
+
+def _abstract(value) -> str:
+    """The abstract shape a storm report names: ``uint8[8,9,19,19]`` for
+    arrays, ``pytree[N]`` for containers, the type name otherwise."""
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if isinstance(value, dict):
+        try:
+            import jax
+
+            return f"pytree[{len(jax.tree.leaves(value))}]"
+        except Exception:  # noqa: BLE001 — description only
+            return f"dict[{len(value)}]"
+    if isinstance(value, (list, tuple)):
+        return f"{type(value).__name__}[{len(value)}]"
+    return type(value).__name__
+
+
+@dataclasses.dataclass(frozen=True)
+class RecompileStorm:
+    """One steady-state (post-warmup) compile, typed for the report."""
+
+    fn: str
+    shapes: tuple[str, ...]
+    cache_before: int
+    cache_after: int
+    thread: str
+    time: float
+
+    def to_dict(self) -> dict:
+        return {"kind": "recompile_storm", "fn": self.fn,
+                "shapes": list(self.shapes),
+                "cache_before": self.cache_before,
+                "cache_after": self.cache_after,
+                "thread": self.thread, "time": self.time}
+
+
+class _Checker:
+    """Global finding store + the ``deepgo_xlacheck_*`` metrics."""
+
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+        # leaf mutex: nothing is acquired while this is held
+        self._mu = threading.Lock()
+        self._storms: list[RecompileStorm] = []
+        self._transfers: list[dict] = []
+        self._sharding: list[dict] = []
+        self._seen_sharding: set[tuple] = set()
+        self._watched: list["_CompileWatch"] = []
+        from ..obs import get_registry
+
+        reg = get_registry()
+        self._obs_recompiles = reg.counter(
+            "deepgo_xlacheck_recompiles_total",
+            "steady-state (post-warmup) XLA compiles caught by the "
+            "recompile sentinel")
+        self._obs_transfers = reg.counter(
+            "deepgo_xlacheck_transfer_violations_total",
+            "implicit host<->device transfers raised inside guarded hot "
+            "sections")
+        self._obs_sharding = reg.counter(
+            "deepgo_xlacheck_sharding_mismatches_total",
+            "declared-vs-actual sharding mismatches on live arrays")
+
+    # -- recompile sentinel ------------------------------------------------
+
+    def register(self, watch: "_CompileWatch") -> None:
+        with self._mu:
+            self._watched.append(watch)
+
+    def record_storm(self, storm: RecompileStorm) -> None:
+        with self._mu:
+            self._storms.append(storm)
+        self._obs_recompiles.inc(storm.cache_after - storm.cache_before,
+                                 fn=storm.fn)
+        print(f"xlacheck: RECOMPILE STORM {storm.fn} compiled post-warmup "
+              f"(cache {storm.cache_before} -> {storm.cache_after}) for "
+              f"shapes [{', '.join(storm.shapes)}] on thread "
+              f"{storm.thread}", file=sys.stderr, flush=True)
+        self._flight("recompile_storm", **storm.to_dict())
+
+    # -- transfer guard ----------------------------------------------------
+
+    def record_transfer(self, tag: str, error: BaseException) -> None:
+        record = {"kind": "implicit_transfer", "tag": tag,
+                  "error": str(error)[:400],
+                  "thread": threading.current_thread().name,
+                  "time": self.clock()}
+        with self._mu:
+            self._transfers.append(record)
+        self._obs_transfers.inc(tag=tag)
+        self._flight("implicit_transfer", **record)
+
+    # -- sharding claims ---------------------------------------------------
+
+    def record_sharding(self, tag: str, path: str, problem: str,
+                        declared, actual) -> dict | None:
+        key = (tag, path)
+        record = {"kind": "sharding_claim", "tag": tag, "path": path,
+                  "problem": problem, "declared": str(declared),
+                  "actual": str(actual), "time": self.clock()}
+        with self._mu:
+            if key in self._seen_sharding:
+                return record  # report once per (tag, leaf), like hazards
+            self._seen_sharding.add(key)
+            self._sharding.append(record)
+        self._obs_sharding.inc(tag=tag)
+        print(f"xlacheck: SHARDING CLAIM {tag}{path}: {problem} "
+              f"(declared {declared}, actual {actual})",
+              file=sys.stderr, flush=True)
+        self._flight("sharding_claim", **record)
+        return record
+
+    def _flight(self, reason: str, **detail) -> None:
+        try:
+            from ..obs.sentinel import flight_dump
+
+            flight_dump(reason, **detail)
+        except Exception:  # noqa: BLE001 — detection must never raise out
+            pass
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self) -> dict:
+        with self._mu:
+            watched: dict[str, dict] = {}
+            for w in self._watched:
+                agg = watched.setdefault(
+                    w.name, {"compiles": 0, "steady_state_compiles": 0,
+                             "warm": False})
+                agg["compiles"] += w.compiles
+                agg["steady_state_compiles"] += w.steady_state_compiles
+                agg["warm"] = agg["warm"] or w.warm
+            return {
+                "enabled": enabled(),
+                "watched": watched,
+                "steady_state_compiles": sum(
+                    v["steady_state_compiles"] for v in watched.values()),
+                "storms": [s.to_dict() for s in self._storms],
+                "transfers": list(self._transfers),
+                "sharding": list(self._sharding),
+            }
+
+
+class _CompileWatch:
+    """A jitted callable with a compile counter and a warmup boundary.
+
+    Reads the wrapped function's jit-cache size before/after each call
+    (the same ``_cache_size`` plumbing ``compile_cache_size`` exposes up
+    the engine/supervisor/fleet stack); growth after :meth:`mark_warm`
+    is a steady-state compile — a :class:`RecompileStorm`."""
+
+    def __init__(self, fn, name: str, checker: _Checker):
+        self._fn = fn
+        self.name = name
+        self._checker = checker
+        self.warm = False
+        self.compiles = 0
+        self.steady_state_compiles = 0
+        # the engine stack discovers the cache via getattr(fn,
+        # "_cache_size"), so the wrapper keeps that surface
+        self._cache_size = self.cache_size
+        checker.register(self)
+
+    def cache_size(self) -> int | None:
+        probe = getattr(self._fn, "_cache_size", None)
+        try:
+            return probe() if callable(probe) else None
+        except Exception:  # noqa: BLE001 — a dying fn must not mask calls
+            return None
+
+    def mark_warm(self) -> None:
+        """Warmup is over: the compile budget is now zero."""
+        self.warm = True
+
+    def __call__(self, *args, **kwargs):
+        before = self.cache_size()
+        out = self._fn(*args, **kwargs)
+        after = self.cache_size()
+        if before is not None and after is not None and after > before:
+            self.compiles += after - before
+            if self.warm:
+                self.steady_state_compiles += after - before
+                self._checker.record_storm(RecompileStorm(
+                    fn=self.name,
+                    shapes=tuple(_abstract(a) for a in args),
+                    cache_before=before, cache_after=after,
+                    thread=threading.current_thread().name,
+                    time=self._checker.clock()))
+        return out
+
+    def __repr__(self) -> str:
+        return f"_CompileWatch({self.name!r}, warm={self.warm})"
+
+
+class _TransferGuard:
+    """``jax.transfer_guard("disallow")`` that records violations on
+    their way out (the exception still propagates — the finding raises
+    at the exact line, the engine's containment types it)."""
+
+    def __init__(self, tag: str, checker: _Checker):
+        self.tag = tag
+        self._checker = checker
+        self._cm = None
+
+    def __enter__(self) -> "_TransferGuard":
+        import jax
+
+        self._cm = jax.transfer_guard("disallow")
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._cm.__exit__(exc_type, exc, tb)
+        if exc is not None and "Disallowed" in str(exc) \
+                and "transfer" in str(exc):
+            self._checker.record_transfer(self.tag, exc)
+        return False
+
+
+_checker: _Checker | None = None
+_checker_mu = threading.Lock()
+
+
+def _get() -> _Checker:
+    global _checker
+    if _checker is None:
+        with _checker_mu:
+            if _checker is None:
+                _checker = _Checker()
+    return _checker
+
+
+def watch_compiles(fn, name: str):
+    """Wrap a jitted forward with the recompile sentinel; returns ``fn``
+    unchanged when the sanitizer is off (zero hot-path cost)."""
+    if not enabled():
+        return fn
+    return _CompileWatch(fn, name, _get())
+
+
+def mark_warm(fn) -> None:
+    """Declare warmup complete for a watched forward (no-op on an
+    unwrapped fn — the off-mode engine calls this unconditionally)."""
+    if isinstance(fn, _CompileWatch):
+        fn.mark_warm()
+
+
+def transfer_guard(tag: str):
+    """Guard a hot section against implicit transfers: a no-op context
+    manager when off, ``jax.transfer_guard("disallow")`` (with violation
+    recording) when armed."""
+    if not enabled():
+        return contextlib.nullcontext()
+    return _TransferGuard(tag, _get())
+
+
+def stage_h2d(*values):
+    """Explicit ``device_put`` at a DECLARED transfer point — identity
+    when off. Inside a :func:`transfer_guard` section only transfers
+    staged through here (or ``jax.device_get``) are legal."""
+    if not enabled():
+        return values
+    import jax
+
+    return tuple(jax.device_put(v) for v in values)
+
+
+def _equivalent(declared, actual, ndim: int) -> bool:
+    try:
+        return bool(declared.is_equivalent_to(actual, ndim))
+    except Exception:  # noqa: BLE001 — fall back to spec comparison
+        return str(getattr(declared, "spec", declared)) == \
+            str(getattr(actual, "spec", actual))
+
+
+def check_sharding(tag: str, tree, shardings) -> list[dict]:
+    """Verify declared shardings against the ``.sharding`` of live
+    arrays; returns the mismatch records (empty when off or in parity).
+
+    The headline failure this catches: a leaf DECLARED sharded that is
+    actually fully replicated — the silent fallback that makes every
+    "fits because it is sharded" claim a lie. Host-resident leaves
+    (never placed) and plain placement mismatches are findings too."""
+    if not enabled():
+        return []
+    import jax
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    checker = _get()
+    leaves, _ = tree_flatten_with_path(tree)
+    decls = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "is_fully_replicated"))
+    findings: list[dict] = []
+    for (path, leaf), declared in zip(leaves, decls):
+        actual = getattr(leaf, "sharding", None)
+        problem = None
+        if actual is None:
+            problem = "leaf has no sharding (host array, never placed)"
+        else:
+            declared_rep = declared.is_fully_replicated
+            actual_rep = actual.is_fully_replicated
+            if not declared_rep and actual_rep:
+                problem = ("declared sharded but actually FULLY "
+                           "REPLICATED — the silent-fallback failure")
+            elif declared_rep != actual_rep or not _equivalent(
+                    declared, actual, getattr(leaf, "ndim", 0)):
+                problem = "placement does not match the declared sharding"
+        if problem is not None:
+            rec = checker.record_sharding(tag, keystr(path), problem,
+                                          declared, actual)
+            if rec is not None:
+                findings.append(rec)
+    return findings
+
+
+def report() -> dict:
+    """Snapshot of watched forwards, storms, transfer violations, and
+    sharding-claim mismatches."""
+    return _get().report()
+
+
+def reset(clock=time.monotonic) -> None:
+    """Discard all recorded state (tests; each scenario gets a fresh
+    checker). Watches made before the reset keep counting — into their
+    original checker, which report() no longer reads."""
+    global _checker
+    with _checker_mu:
+        _checker = _Checker(clock=clock)

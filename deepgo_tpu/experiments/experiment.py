@@ -401,6 +401,7 @@ class Experiment:
             # bucket of the attribution table.
             t0 = time.monotonic()
             for losses in pending:
+                # lint: allow[hot-sync] window-boundary fetch IS the declared materialization point (one d2h per window)
                 for value in np.atleast_1d(np.asarray(losses)).tolist():
                     ewma = value if ewma is None else 0.95 * ewma + 0.05 * value
                     last_loss = value
@@ -468,6 +469,7 @@ class Experiment:
                     # Atomic so a crash while dumping can't tear an earlier
                     # capture — the postmortem artifact deserves the same
                     # guarantee as the checkpoint.
+                    # lint: allow[hot-sync] crash-path postmortem dump — the step already failed, there is no pipeline left to stall
                     bad = {k_: np.asarray(v) for k_, v in batch.items()}
                     with atomic_write(
                         os.path.join(self.run_path, "bad_batch.npz")

@@ -85,12 +85,14 @@ def make_sym_policy_fn(cfg: policy_cnn.ModelConfig,
     from .. import NUM_POINTS
 
     expand_planes = get_expand_fn(expand_backend)
+    # hoisted out of the jitted body (constant-upload): uploaded once at
+    # factory time instead of re-baked from host memory on every trace
+    perm = jnp.asarray(_PERM_NP)          # (8, 361) gather tables
+    tmap = jnp.asarray(_TARGET_MAP_NP)    # (8, 361) inverse tables
 
     @jax.jit
     def predict(params, packed, player, rank):
         b, ch = packed.shape[0], packed.shape[1]
-        perm = jnp.asarray(_PERM_NP)          # (8, 361) gather tables
-        tmap = jnp.asarray(_TARGET_MAP_NP)    # (8, 361) inverse tables
         flat = packed.reshape(b, ch, NUM_POINTS)
         views = flat[:, :, perm]              # (B, C, 8, 361)
         views = views.transpose(2, 0, 1, 3).reshape(

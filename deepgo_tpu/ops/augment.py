@@ -47,6 +47,12 @@ def _dihedral_tables() -> tuple[np.ndarray, np.ndarray]:
 
 
 _PERM_NP, _TARGET_MAP_NP = _dihedral_tables()
+# the tables are baked into every compiled program that traces through
+# augment_batch (jit-boundary): freeze them so an accidental in-place
+# mutation raises immediately instead of silently serving programs
+# compiled against the old values
+_PERM_NP.setflags(write=False)
+_TARGET_MAP_NP.setflags(write=False)
 NUM_SYMMETRIES = 8
 
 
@@ -57,10 +63,12 @@ def augment_batch(packed, target, sym):
     -> (packed', target') with identical semantics under Go's symmetry group.
     """
     b = packed.shape[0]
+    # lint: allow[jit-boundary] tables frozen read-only at module init (setflags); baked per compile by design
     perm = jnp.asarray(_PERM_NP)[sym]  # (B, 361)
     flat = packed.reshape(b, packed.shape[1], NUM_POINTS)
     out = jnp.take_along_axis(flat, perm[:, None, :], axis=2)
     new_target = jnp.take_along_axis(
+        # lint: allow[jit-boundary] tables frozen read-only at module init (setflags); baked per compile by design
         jnp.asarray(_TARGET_MAP_NP)[sym], target[:, None], axis=1
     )[:, 0]
     return out.reshape(packed.shape), new_target

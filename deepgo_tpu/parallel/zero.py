@@ -68,10 +68,18 @@ def zero_sharding(opt_state, mesh: Mesh):
 
 
 def shard_opt_state(opt_state, mesh: Mesh):
-    """device_put the optimizer state under zero_sharding placements."""
-    return jax.tree.map(
-        lambda leaf, s: jax.device_put(leaf, s),
-        opt_state, zero_sharding(opt_state, mesh))
+    """device_put the optimizer state under zero_sharding placements.
+
+    Verified against the declared map when ``DEEPGO_XLACHECK=1``
+    (analysis/xlacheck.py): a ZeRO leaf that silently fell back to full
+    replication is a recorded sharding-claim finding."""
+    shardings = zero_sharding(opt_state, mesh)
+    placed = jax.tree.map(
+        lambda leaf, s: jax.device_put(leaf, s), opt_state, shardings)
+    from ..analysis import xlacheck
+
+    xlacheck.check_sharding("zero.opt_state", placed, shardings)
+    return placed
 
 
 def sharded_fraction(opt_state) -> float:

@@ -31,6 +31,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..analysis import xlacheck
 from ..analysis.lockcheck import make_lock
 from ..obs import get_registry
 from ..utils import faults
@@ -110,7 +111,12 @@ class InferenceEngine:
         self.config = config or EngineConfig()
         self.ladder = BucketLadder(self.config.buckets)
         self.name = name
-        self._forward = forward
+        # DEEPGO_XLACHECK=1 arms the recompile sentinel: the forward is
+        # wrapped with a per-engine compile counter (zero budget after
+        # warmup); off, the fn passes through untouched and the dispatch
+        # loop pays one attribute check (docs/static_analysis.md)
+        self._forward = xlacheck.watch_compiles(forward, name=name)
+        self._xla_on = xlacheck.enabled()
         self._params = params
         self._metrics = metrics
         self._queue: queue.Queue[_Request] = queue.Queue(
@@ -178,8 +184,18 @@ class InferenceEngine:
             packed = np.zeros((b, 9, 19, 19), dtype=np.uint8)
             player = np.ones(b, dtype=np.int32)
             rank = np.ones(b, dtype=np.int32)
-            np.asarray(self._forward(self._params, packed, player, rank))
+            args = (self._params, packed, player, rank)
+            if self._xla_on:
+                # stage exactly like the armed dispatch: a weak-typed
+                # Python scalar traced here and a device_put-concrete
+                # one there would be DIFFERENT programs — the sentinel
+                # would (correctly) call the first dispatch a storm
+                args = xlacheck.stage_h2d(*args)
+            np.asarray(self._forward(*args))
         self._warm_shapes = len(self.ladder.buckets)
+        # warmup over: from here any compile is a steady-state compile —
+        # a typed RecompileStorm finding when the sentinel is armed
+        xlacheck.mark_warm(self._forward)
         return self._warm_shapes
 
     def compile_cache_size(self) -> int | None:
@@ -374,8 +390,18 @@ class InferenceEngine:
         t_fwd = time.monotonic()
         try:
             faults.check("serving_forward")
-            out = np.asarray(
-                self._forward(self._params, packed, players, ranks))
+            if self._xla_on:
+                # the DECLARED h2d point: stage explicitly so the armed
+                # transfer guard proves the guarded forward performs no
+                # implicit transfer (an implicit one raises at its line)
+                params, packed, players, ranks = xlacheck.stage_h2d(
+                    self._params, packed, players, ranks)
+                with xlacheck.transfer_guard(f"engine.{self.name}"):
+                    out = self._forward(params, packed, players, ranks)
+            else:
+                out = self._forward(self._params, packed, players, ranks)
+            # lint: allow[hot-sync] dispatch-time d2h is the DECLARED materialization point: one fetch per coalesced batch (docs/static_analysis.md)
+            out = np.asarray(out)
         except BaseException as e:  # noqa: BLE001 — typed onto the futures
             # contain the blast radius to THIS batch: its futures fail with
             # a typed wrapper (cause attached), the dispatcher keeps

@@ -252,7 +252,19 @@ class FleetRouter:
         return warmed
 
     def compile_cache_size(self) -> int | None:
-        return self._replicas[0].engine.compile_cache_size()
+        """Fleet-wide compile count: the SUM over replicas (None when no
+        replica exposes a cache). Summing keeps the zero-recompile
+        contract assertable at the fleet surface — any replica compiling
+        post-warmup moves the total."""
+        sizes = [s for s in self.compile_cache_sizes() if s is not None]
+        return sum(sizes) if sizes else None
+
+    def compile_cache_sizes(self) -> list[int | None]:
+        """Per-replica compile counts, index-aligned with replica ids —
+        what lets the recompile sentinel (analysis/xlacheck.py,
+        DEEPGO_XLACHECK=1) attribute a storm to the replica that
+        actually compiled instead of reporting replica 0 for everyone."""
+        return [rep.engine.compile_cache_size() for rep in self._replicas]
 
     @property
     def ladder(self):

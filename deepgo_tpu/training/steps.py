@@ -172,6 +172,7 @@ def make_eval_step(cfg: policy_cnn.ModelConfig, expand_backend: str = "xla",
     expand_planes = get_expand_fn(expand_backend)
 
     @jax.jit
+    # lint: allow[donation] eval reuses params across every validation batch — donation would consume the caller's copy
     def step(params, batch):
         planes = expand_planes(
             _unwire(batch["packed"], wire), batch["player"], batch["rank"],

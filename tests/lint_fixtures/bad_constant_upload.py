@@ -1,0 +1,35 @@
+"""Known-bad fixture for constant-upload. Lines pinned by
+tests/test_analysis.py."""
+import jax
+import jax.numpy as jnp
+
+from tables import BIG_TABLE  # AST-only: resolved names never execute
+
+
+def per_call(x):
+    t = jnp.asarray(BIG_TABLE)  # line 10: re-uploads the constant per call
+    return x + t
+
+
+@jax.jit
+def jitted(x):
+    return x + jnp.array(BIG_TABLE)  # line 16: re-baked per trace
+
+
+def make_forward():
+    table = jnp.asarray(BIG_TABLE)  # factory scope (hoist target): OK
+
+    def forward(x):
+        return x + table
+
+    return forward
+
+
+def lowercase_local(x):
+    y = jnp.asarray(x)  # lowercase name: OK (data, not a constant)
+    return y
+
+
+def declared(x):
+    # lint: allow[constant-upload] fixture: tiny scalar table, measured irrelevant
+    return x + jnp.asarray(BIG_TABLE)  # suppressed

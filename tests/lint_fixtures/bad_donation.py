@@ -1,0 +1,35 @@
+"""Known-bad fixture for donation. Lines pinned by tests/test_analysis.py."""
+import functools
+
+import jax
+
+
+@jax.jit
+def step(params, opt_state, batch):  # line 8: step-shaped, no donation
+    return params, opt_state, 0.0
+
+
+@jax.jit
+def eval_step(params, batch):  # line 13: *step taking params, no donation
+    return batch
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def good_step(params, opt_state, batch):
+    return params, opt_state, 0.0
+
+
+def run(params, opt_state, batch):
+    params2, opt2, loss = good_step(params, opt_state, batch)
+    return params, loss  # line 24: donated `params` read after the call
+
+
+def run_ok(params, opt_state, batch):
+    params, opt_state, loss = good_step(params, opt_state, batch)
+    return params, loss  # rebound by the call's own targets: OK
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+# lint: allow[donation] fixture: a reasoned pragma suppresses the def line
+def pragma_step(params, opt_state, batch):
+    return params, opt_state, 0.0

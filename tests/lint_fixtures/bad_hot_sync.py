@@ -1,0 +1,39 @@
+"""Known-bad fixture for hot-sync (explicit-path mode treats every
+function as hot). Lines pinned by tests/test_analysis.py."""
+import jax
+import numpy as np
+
+
+def dispatch(forward, params, batch):
+    return np.asarray(forward(params, batch))  # line 8: d2h per dispatch
+
+
+def peek(loss):
+    return loss.item()  # line 12: per-step device sync
+
+
+def fence(x):
+    jax.block_until_ready(x)  # line 16: pipeline stall
+    return x
+
+
+def fence_method(x):
+    x.block_until_ready()  # line 21: same stall, method form
+    return x
+
+
+def pull(x):
+    return jax.device_get(x)  # line 26: explicit d2h in a hot path
+
+
+def fold(step, params, batch):
+    return float(step(params, batch))  # line 30: float() materializes
+
+
+def host_math(samples):
+    return float(np.percentile(samples, 50))  # host numpy: OK
+
+
+def declared(forward, params, batch):
+    # lint: allow[hot-sync] fixture: the declared materialization point
+    return np.asarray(forward(params, batch))  # suppressed

@@ -1,0 +1,54 @@
+"""Known-bad fixture for jit-boundary. Lines pinned by
+tests/test_analysis.py — edit with care. AST-only: never imported."""
+import functools
+
+import jax
+import numpy as np
+
+_TABLE = np.arange(8)      # module-level mutable array state
+OK_TUPLE = (1, 2, 3)       # immutable literal: never flagged
+
+
+class Model:
+    @jax.jit
+    def forward(self, x):
+        return x * self.scale  # line 15: jitted fn reads instance state
+
+
+@jax.jit
+def bake(x):
+    return x + _TABLE  # line 20: bakes module-level mutable array
+
+
+@jax.jit
+def bad_flag(x, mode="fast"):  # line 24: str default traced per call
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def ok_static(x, mode="fast"):  # static string arg: OK
+    return x
+
+
+def _inner(x):
+    return x * _TABLE  # line 34: shard_map'd fn bakes module state
+
+
+mapped = shard_map(_inner, mesh=None, in_specs=None, out_specs=None)
+
+
+def _wrapped(x):
+    return x + _TABLE  # line 41: jit-wrapped-by-assignment fn
+
+
+wrapped = jax.jit(_wrapped)
+
+
+@jax.jit
+def pragma_ok(x):
+    # lint: allow[jit-boundary] fixture: table frozen read-only at module init
+    return x + _TABLE  # suppressed by the reasoned pragma above
+
+
+def plain_host_read(x):
+    return x + _TABLE[0]  # not jitted: host code may read module arrays

@@ -77,6 +77,42 @@ def test_pragma_fixture():
     ]
 
 
+def test_jit_boundary_fixture():
+    assert keyed(fixture_findings("bad_jit_boundary.py")) == [
+        ("jit-boundary", 15),  # jitted method reads self.scale
+        ("jit-boundary", 20),  # jit bakes module-level mutable array
+        ("jit-boundary", 24),  # str-default param without static_argnames
+        ("jit-boundary", 34),  # shard_map'd fn bakes module state
+        ("jit-boundary", 41),  # jit-wrapped-by-assignment fn
+    ]  # ok_static / the pragma'd read / plain host reads are NOT here
+
+
+def test_hot_sync_fixture():
+    assert keyed(fixture_findings("bad_hot_sync.py")) == [
+        ("hot-sync", 8),   # np.asarray on a forward result
+        ("hot-sync", 12),  # .item()
+        ("hot-sync", 16),  # jax.block_until_ready
+        ("hot-sync", 21),  # .block_until_ready() method form
+        ("hot-sync", 26),  # jax.device_get
+        ("hot-sync", 30),  # float(<device call>)
+    ]  # float(np.percentile(...)) and the pragma'd site are NOT here
+
+
+def test_donation_fixture():
+    assert keyed(fixture_findings("bad_donation.py")) == [
+        ("donation", 8),   # params+opt_state jit without donate_argnums
+        ("donation", 13),  # *step taking params, no donation
+        ("donation", 24),  # donated buffer read after the call
+    ]  # good_step / run_ok's rebind / the pragma'd def are NOT here
+
+
+def test_constant_upload_fixture():
+    assert keyed(fixture_findings("bad_constant_upload.py")) == [
+        ("constant-upload", 10),  # per-call jnp.asarray(CONST)
+        ("constant-upload", 16),  # re-baked per trace inside a jit
+    ]  # factory-scope hoist / lowercase locals / pragma are NOT here
+
+
 def test_clean_fixture_has_no_findings():
     assert fixture_findings("clean_ok.py") == []
 
@@ -241,6 +277,23 @@ def test_cli_lint_json_exit_code(capsys):
     assert out["strict"] == 3
     rules = {f["rule"] for f in out["findings"]}
     assert rules == {"atomic-write"}
+    assert all(f["hint"] for f in out["findings"])
+
+
+def test_cli_lint_json_includes_xla_rule_ids(capsys):
+    from deepgo_tpu import cli
+
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", "--root", REPO, "--json", "--no-grammar",
+                  os.path.join(FIXTURES, "bad_jit_boundary.py"),
+                  os.path.join(FIXTURES, "bad_hot_sync.py"),
+                  os.path.join(FIXTURES, "bad_donation.py"),
+                  os.path.join(FIXTURES, "bad_constant_upload.py")])
+    assert exc.value.code == 1
+    out = json.loads(capsys.readouterr().out)
+    rules = {f["rule"] for f in out["findings"]}
+    assert {"jit-boundary", "hot-sync", "donation",
+            "constant-upload"} <= rules
     assert all(f["hint"] for f in out["findings"])
 
 

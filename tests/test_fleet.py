@@ -344,6 +344,22 @@ class TestFailover:
             fleet.close()
 
 
+def test_compile_cache_sizes_per_replica():
+    """The recompile sentinel's attribution surface: one count per
+    replica (not replica 0 echoed), with the scalar surface the SUM."""
+    cfg, params = tiny()
+    fleet = fleet_policy_engine(params, cfg, replicas=2, config=ECFG,
+                                name="cache-fleet")
+    try:
+        fleet.warmup()
+        sizes = fleet.compile_cache_sizes()
+        assert len(sizes) == 2
+        assert all(isinstance(s, int) and s > 0 for s in sizes)
+        assert fleet.compile_cache_size() == sum(sizes)
+    finally:
+        fleet.close()
+
+
 class TestReload:
     def test_reload_parity_bitwise_with_fresh_engine(self):
         cfg, params_a = tiny()

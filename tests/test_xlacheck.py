@@ -1,0 +1,334 @@
+"""XLA performance-contract sanitizer (analysis/xlacheck.py,
+``DEEPGO_XLACHECK=1`` — docs/static_analysis.md).
+
+The load-bearing contracts:
+
+  * OFF is free: ``watch_compiles`` returns the fn untouched, the guard
+    is a nullcontext, ``stage_h2d`` is identity, ``check_sharding``
+    returns nothing — the production hot paths pay one attribute check.
+  * the recompile sentinel's budget is ZERO after ``mark_warm``: any
+    later compile is a typed ``RecompileStorm`` carrying the triggering
+    abstract shapes, dumped through the flight recorder — including one
+    forced through a REAL engine submit with a mixed-dtype board.
+  * the transfer guard raises on an implicit h2d at the exact call and
+    records the violation; transfers staged through ``stage_h2d`` pass.
+  * the sharding-claim checker catches "declared sharded, actually
+    replicated" (and never-placed leaves) on live arrays, and the
+    tensor/ZeRO placement paths verify clean.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepgo_tpu.analysis import xlacheck
+from deepgo_tpu.serving import EngineConfig, InferenceEngine
+
+
+@pytest.fixture
+def armed():
+    xlacheck.enable(True)
+    xlacheck.reset()
+    try:
+        yield
+    finally:
+        xlacheck.enable(None)
+        xlacheck.reset()
+
+
+def _row_forward():
+    """Engine-compatible row-independent jitted forward."""
+    return jax.jit(
+        lambda params, packed, player, rank:
+        packed.astype(jnp.float32).reshape(packed.shape[0], -1).sum(-1)
+        + params)
+
+
+def _board(dtype=np.uint8):
+    return np.zeros((9, 19, 19), dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# off-mode: everything is a no-op
+
+
+class TestOff:
+    def test_watch_is_identity(self):
+        assert xlacheck.enabled() is False
+        f = _row_forward()
+        assert xlacheck.watch_compiles(f, name="x") is f
+        xlacheck.mark_warm(f)  # no-op on an unwrapped fn
+
+    def test_guard_is_nullcontext_and_stage_is_identity(self):
+        f = jax.jit(lambda x: x + 1)
+        x = np.ones((4,), np.float32)
+        with xlacheck.transfer_guard("off"):
+            f(x)  # an implicit h2d that would raise when armed
+        staged = xlacheck.stage_h2d(x)
+        assert staged[0] is x
+
+    def test_check_sharding_returns_nothing(self):
+        assert xlacheck.check_sharding("off", [np.zeros(4)], [None]) == []
+
+    def test_engine_keeps_raw_forward(self):
+        f = _row_forward()
+        with InferenceEngine(f, 0.0, EngineConfig(buckets=(1, 4),
+                                                  max_wait_ms=0.0),
+                             name="xla-off") as eng:
+            assert eng._forward is f
+
+
+# ---------------------------------------------------------------------------
+# the recompile sentinel
+
+
+class TestRecompileSentinel:
+    def test_watch_counts_and_storms(self, armed):
+        w = xlacheck.watch_compiles(_row_forward(), name="fn")
+        w(0.0, np.zeros((2, 9, 19, 19), np.uint8),
+          np.ones(2, np.int32), np.ones(2, np.int32))
+        assert w.compiles >= 1
+        assert w.steady_state_compiles == 0
+        xlacheck.mark_warm(w)
+        # same shape again: warm, no storm
+        w(0.0, np.zeros((2, 9, 19, 19), np.uint8),
+          np.ones(2, np.int32), np.ones(2, np.int32))
+        assert w.steady_state_compiles == 0
+        # new batch shape post-warm: a steady-state compile
+        w(0.0, np.zeros((3, 9, 19, 19), np.uint8),
+          np.ones(3, np.int32), np.ones(3, np.int32))
+        assert w.steady_state_compiles >= 1
+        rep = xlacheck.report()
+        assert rep["steady_state_compiles"] >= 1
+        storm = rep["storms"][0]
+        assert storm["kind"] == "recompile_storm"
+        assert storm["fn"] == "fn"
+        assert any("uint8[3,9,19,19]" in s for s in storm["shapes"])
+        assert storm["cache_after"] > storm["cache_before"]
+
+    def test_cache_size_surface_survives_wrapping(self, armed):
+        w = xlacheck.watch_compiles(_row_forward(), name="fn")
+        probe = getattr(w, "_cache_size", None)
+        assert callable(probe)
+        before = probe()
+        w(0.0, np.zeros((1, 9, 19, 19), np.uint8),
+          np.ones(1, np.int32), np.ones(1, np.int32))
+        assert probe() > before
+
+    def test_unwatchable_fn_never_storms(self, armed):
+        w = xlacheck.watch_compiles(lambda *a: np.zeros(1), name="plain")
+        xlacheck.mark_warm(w)
+        w(0.0, np.zeros((1, 9, 19, 19), np.uint8), None, None)
+        assert xlacheck.report()["storms"] == []
+
+    def test_live_storm_through_mixed_dtype_submit(self, armed, tmp_path):
+        """The satellite's live test: a steady-state compile forced
+        through a REAL engine submit (a float32 board after a uint8
+        warmup — each distinct dtype is a distinct compiled program),
+        asserting the typed finding AND the flight-recorder dump."""
+        from deepgo_tpu.obs.sentinel import get_flight_recorder
+
+        rec = get_flight_recorder()
+        rec.configure(str(tmp_path))
+        try:
+            with InferenceEngine(_row_forward(), 0.0,
+                                 EngineConfig(buckets=(1, 4),
+                                              max_wait_ms=0.0),
+                                 name="xla-live") as eng:
+                assert eng.warmup() == 2
+                assert xlacheck.report()["steady_state_compiles"] == 0
+                # on-ladder mixed-COUNT submits stay within budget
+                for _ in range(3):
+                    eng.submit(_board(), 1, 1).result(timeout=30)
+                assert xlacheck.report()["steady_state_compiles"] == 0
+                # the mixed-dtype submit: silently compiles post-warmup
+                eng.submit(_board(np.float32), 1, 1).result(timeout=30)
+            rep = xlacheck.report()
+            assert rep["steady_state_compiles"] >= 1
+            storm = rep["storms"][0]
+            assert storm["fn"] == "xla-live"
+            assert any("float32[1,9,19,19]" in s for s in storm["shapes"])
+            dumps = [p for p in os.listdir(tmp_path)
+                     if p.startswith("flight-")]
+            assert dumps, "storm did not reach the flight recorder"
+            with open(os.path.join(tmp_path, sorted(dumps)[0])) as f:
+                dump = json.load(f)
+            assert dump["reason"] == "recompile_storm"
+            assert dump["detail"]["fn"] == "xla-live"
+        finally:
+            rec.close()
+
+    def test_warm_engine_zero_budget_holds(self, armed):
+        with InferenceEngine(_row_forward(), 0.0,
+                             EngineConfig(buckets=(1, 4), max_wait_ms=0.0),
+                             name="xla-clean") as eng:
+            eng.warmup()
+            for _ in range(5):
+                eng.submit(_board(), 1, 1).result(timeout=30)
+        assert xlacheck.report()["steady_state_compiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the transfer guard
+
+
+class TestTransferGuard:
+    def test_implicit_h2d_raises_and_is_recorded(self, armed):
+        f = jax.jit(lambda x: x + 1)
+        x = np.ones((4,), np.float32)
+        f(x)  # warm, unguarded
+        with pytest.raises(Exception, match="Disallowed"):
+            with xlacheck.transfer_guard("hot"):
+                f(x)
+        rep = xlacheck.report()
+        assert len(rep["transfers"]) == 1
+        assert rep["transfers"][0]["tag"] == "hot"
+
+    def test_staged_transfer_passes(self, armed):
+        f = jax.jit(lambda x: x + 1)
+        x = np.ones((4,), np.float32)
+        f(x)
+        (xd,) = xlacheck.stage_h2d(x)
+        with xlacheck.transfer_guard("hot"):
+            out = f(xd)
+        assert xlacheck.report()["transfers"] == []
+        assert np.asarray(out)[0] == 2.0
+
+    def test_engine_dispatch_is_guard_clean(self, armed):
+        """The engine's dispatch stages its declared h2d explicitly, so
+        an armed run performs ZERO implicit transfers."""
+        with InferenceEngine(_row_forward(), 0.0,
+                             EngineConfig(buckets=(1, 4), max_wait_ms=0.0),
+                             name="xla-guard") as eng:
+            eng.warmup()
+            out = eng.submit(_board(), 1, 1).result(timeout=30)
+        assert xlacheck.report()["transfers"] == []
+        assert np.asarray(out) is not None
+
+
+# ---------------------------------------------------------------------------
+# the sharding-claim checker (8 virtual CPU devices, conftest.py)
+
+
+class TestShardingClaims:
+    def setup_method(self):
+        from deepgo_tpu.parallel.mesh import make_mesh
+
+        self.mesh = make_mesh(4, 2)
+
+    def test_matching_placement_is_clean(self, armed):
+        x = np.zeros((8, 16), np.float32)
+        sh = NamedSharding(self.mesh, P("data"))
+        placed = jax.device_put(x, sh)
+        assert xlacheck.check_sharding("ok", [placed], [sh]) == []
+
+    def test_declared_sharded_actually_replicated(self, armed):
+        x = np.zeros((8, 16), np.float32)
+        placed = jax.device_put(x, NamedSharding(self.mesh, P()))
+        found = xlacheck.check_sharding(
+            "fallback", [placed], [NamedSharding(self.mesh, P("data"))])
+        assert len(found) == 1
+        assert "REPLICATED" in found[0]["problem"]
+        assert found[0]["kind"] == "sharding_claim"
+        rep = xlacheck.report()
+        assert len(rep["sharding"]) == 1
+
+    def test_never_placed_host_leaf(self, armed):
+        x = np.zeros((8, 16), np.float32)
+        found = xlacheck.check_sharding(
+            "host", [x], [NamedSharding(self.mesh, P("data"))])
+        assert len(found) == 1
+        assert "never placed" in found[0]["problem"]
+
+    def test_dedup_per_tag_and_leaf(self, armed):
+        x = np.zeros((8, 16), np.float32)
+        decl = [NamedSharding(self.mesh, P("data"))]
+        xlacheck.check_sharding("dup", [x], decl)
+        xlacheck.check_sharding("dup", [x], decl)
+        assert len(xlacheck.report()["sharding"]) == 1
+
+    def test_tensor_placement_verifies_clean(self, armed):
+        from deepgo_tpu.models import ModelConfig, init
+        from deepgo_tpu.parallel import tensor
+
+        cfg = ModelConfig(num_layers=2, channels=8)
+        params = init(jax.random.key(0), cfg)
+        placed = tensor.shard_params(params, self.mesh)
+        assert xlacheck.report()["sharding"] == []
+        # and the placement actually sharded the hidden convs (the
+        # 1-channel head, layers[-1], legitimately stays replicated)
+        ws = placed["layers"][0]["w"]
+        assert not ws.sharding.is_fully_replicated
+
+    def test_zero_placement_verifies_clean(self, armed):
+        from deepgo_tpu.models import ModelConfig, init
+        from deepgo_tpu.parallel import zero
+        from deepgo_tpu.training.optimizers import OPTIMIZERS
+
+        cfg = ModelConfig(num_layers=2, channels=8)
+        params = init(jax.random.key(0), cfg)
+        opt = OPTIMIZERS["sgd"](0.01, 1e-7, 0.9)
+        opt_state = opt.init(params)
+        zero.shard_opt_state(opt_state, self.mesh)
+        assert xlacheck.report()["sharding"] == []
+
+
+# ---------------------------------------------------------------------------
+# bench integration: the gate sentinel + the last-good probe refusal
+
+
+class TestBenchWiring:
+    def test_gate_folds_steady_state_compiles(self):
+        import bench
+
+        class Args:
+            gate = 0.10
+
+        result = {"metric": "no_such_metric", "value": 100.0,
+                  "device": "cpu",
+                  "xlacheck": {"steady_state_compiles": 2}}
+        bench._apply_gate(result, Args())
+        assert result["gate"]["verdict"] == "fail"
+        assert result["gate"]["steady_state_compiles"] == 2
+        assert "zero-recompile" in result["gate"]["reason"]
+
+    def test_gate_passes_with_zero_compiles(self):
+        import bench
+
+        class Args:
+            gate = 0.10
+
+        result = {"metric": "no_such_metric", "value": 100.0,
+                  "device": "cpu",
+                  "xlacheck": {"steady_state_compiles": 0}}
+        bench._apply_gate(result, Args())
+        assert result["gate"]["verdict"] == "skip"  # no baseline
+        assert result["gate"]["steady_state_compiles"] == 0
+
+    def test_record_last_good_refuses_stale_and_dead_probe(
+            self, tmp_path, monkeypatch):
+        import bench
+
+        path = tmp_path / "last_good.json"
+        monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(path))
+        bench._record_last_good({"metric": "m", "value": 1.0,
+                                 "stale": True})
+        assert not path.exists()
+        bench._record_last_good({"metric": "m", "value": 1.0,
+                                 "error": "boom"})
+        assert not path.exists()
+        bench._record_last_good({"metric": "m", "value": 1.0,
+                                 "probe": {"live": False}})
+        assert not path.exists()
+        bench._record_last_good({"metric": "m", "value": 2.0,
+                                 "probe": {"live": True}})
+        with open(path) as f:
+            table = json.load(f)
+        assert table["m"]["value"] == 2.0
+        assert table["m"]["probe"]["live"] is True

@@ -22,6 +22,7 @@ corpora — tools/r5_value_loop.sh).
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -80,7 +81,10 @@ def make_step(cfg: value_cnn.ValueConfig, optimizer):
         return jnp.mean(jnp.maximum(logits, 0) - logits * z
                         + jnp.log1p(jnp.exp(-jnp.abs(logits))))
 
-    @jax.jit
+    # donated like the policy train steps (linter rule `donation`): the
+    # caller rebinds params/opt_state every step, so the old buffers are
+    # dead weight XLA can reuse in place
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, packed, player, rank, z):
         loss, grads = jax.value_and_grad(loss_fn)(params, packed, player,
                                                   rank, z)
