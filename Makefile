@@ -3,7 +3,7 @@
 .PHONY: native data test test-full lint verify verify-faults verify-serving \
     verify-resilience verify-fleet verify-distributed verify-obs \
     verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
-    bench bench-gate smoke clean
+    verify-cost bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -60,7 +60,10 @@ verify-analysis:  # invariant linter fixtures + clean-tree run + lock-order sani
 verify-xlacheck:  # XLA-contract sanitizer: recompile sentinel (live storm), transfer guard, sharding claims, bench gate fold
 	JAX_PLATFORMS=cpu python -m pytest tests/test_xlacheck.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck  # the full failure-model suite
+verify-cost:  # device cost ledger: analytic-vs-XLA cross-check, ladder monotonicity, degraded mode, /cost route, MFU-floor gate, attribution MFU join
+	JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -82,7 +82,20 @@ Extra modes (round-2 verdict items 2 and 5), each also one JSON line:
                    rides inside the one JSON line as `gate`; cross-device
                    comparisons skip rather than fail, and a recorded
                    repeat spread (noise_frac) widens the threshold
-                   (obs/sentinel.py, docs/observability.md).
+                   (obs/sentinel.py, docs/observability.md). The gate
+                   also enforces an MFU FLOOR over the roofline block: a
+                   run whose throughput passed but whose per-entrypoint
+                   MFU dropped >= T vs the last-good capture fails —
+                   a "win" that spends hardware efficiency is a latent
+                   regression (obs/costmodel.py).
+
+Roofline: the inference/train/large/serving modes price every jitted
+entrypoint they run AHEAD OF TIME through the device cost ledger
+(obs/costmodel.py — jax AOT lower/compile + XLA cost_analysis() and
+memory_analysis(); zero per-dispatch cost, the ladder is priced before
+any engine exists) and fold the join with their measured timings into
+the JSON as `roofline`: per-entrypoint {flops, bytes, hbm_peak,
+achieved_flops_per_s, mfu, bound} against the detected platform peak.
 """
 
 from __future__ import annotations
@@ -329,12 +342,6 @@ def _preflight_probe(mode: str = "inference") -> dict:
     raise SystemExit(0 if out.get("stale") else 1)
 
 
-def _conv_flops_per_sample(cfg) -> float:
-    """Forward-pass MAC*2 FLOPs of the conv stack for one 19x19 board."""
-    return sum(2.0 * k * k * cin * cout * 361
-               for k, cin, cout in cfg.layer_shapes())
-
-
 def _rand_batch(rng, shape_prefix) -> tuple:
     """Synthetic packed records + player/rank vectors for any (K?, B) prefix."""
     return (
@@ -396,29 +403,43 @@ def _time_train_step(cfg, batch: int, k_steps: int, repeats: int,
 
 def _bench_train(on_tpu: bool) -> dict:
     """Fused-training samples/sec: K chained optimizer steps per dispatch
-    (make_train_step_many), one scalar fetch to fence the measurement."""
+    (make_train_step_many), one scalar fetch to fence the measurement.
+
+    Each config's step program is also priced AHEAD OF TIME by the device
+    cost ledger (obs/costmodel.py) — XLA's own FLOPs/bytes/HBM from
+    ``cost_analysis()``, not the hand estimate — and the join of that
+    bill with the measured step time rides in the JSON as ``roofline``:
+    achieved FLOP/s, MFU vs the detected platform peak (this replaces
+    the old hard-coded ``mfu_est_v5e``), and the compute-vs-memory
+    verdict per config."""
     from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.obs import costmodel
+    from deepgo_tpu.training.optimizers import OPTIMIZERS
 
     rng = np.random.default_rng(0)
     configs = [("3L/64", "small"), ("12L/128", "full")]
     batch, k_steps, repeats = (1024, 16, 3) if on_tpu else (64, 2, 1)
+    ledger = costmodel.CostLedger()
+    costmodel.set_cost_ledger(ledger)
     out = {}
+    timings = {}
     for label, name in configs:
         cfg = policy_cnn.CONFIGS[name]
+        fn = f"train_step:{name}"
+        costmodel.train_entry(ledger, cfg, batch,
+                              optimizer=OPTIMIZERS["sgd"](0.01, 1e-7, 0.0),
+                              fn_name=fn)
         sps, ms_per_step = _time_train_step(cfg, batch, k_steps, repeats, rng)
+        timings[(fn, batch)] = ms_per_step / 1000.0
         out[label] = {
             "samples_per_sec": round(sps, 1),
             "ms_per_step": round(ms_per_step, 3),
         }
-        # fwd + bwd ~= 3x forward FLOPs (standard estimate)
+        # fwd + bwd ~= 3x forward FLOPs (the analytic estimate, kept for
+        # continuity with earlier rounds; the roofline block carries the
+        # compiler-counted number)
         out[label]["tflops_est"] = round(
-            3 * _conv_flops_per_sample(cfg) * sps / 1e12, 1)
-    if on_tpu:
-        # MFU against v5e peak bf16 (197 TFLOPs) on the flagship config;
-        # only meaningful on the TPU this bench targets, so gated
-        peak = 197.0
-        out["12L/128"]["mfu_est_v5e"] = round(
-            out["12L/128"]["tflops_est"] / peak, 3)
+            costmodel.analytic_train_flops(cfg) * sps / 1e12, 1)
     return {
         "metric": "fused_training_samples_per_sec_per_chip",
         "value": out["12L/128"]["samples_per_sec"],
@@ -427,6 +448,7 @@ def _bench_train(on_tpu: bool) -> dict:
         "batch": batch,
         "steps_per_call": k_steps,
         "configs": out,
+        "roofline": ledger.roofline(timings),
     }
 
 
@@ -446,38 +468,56 @@ def _peak_mem_mb():
 def _bench_large(on_tpu: bool) -> dict:
     """13L/256 ("large", the AlphaGo SL-policy scale config) training step
     with rematerialization on vs off: samples/sec plus the device memory
-    high-water — the HBM-vs-FLOPs trade measured rather than asserted.
+    bill — the HBM-vs-FLOPs trade measured rather than asserted.
 
-    remat=True runs FIRST: the allocator's peak_bytes_in_use is a process
-    high-water with no reset API, so the first reading is the remat peak
+    Two memory numbers, deliberately both: ``hbm_peak_mb`` is the AOT
+    cost ledger's ``memory_analysis()`` bill (argument + output + temp)
+    for THIS program alone — the number that actually OOMs a TPU, and it
+    is known before anything runs; ``peak_mem_mb_cumulative`` is the
+    allocator's process high-water (PJRT memory_stats), which is
+    cumulative across settings. remat=True runs FIRST: the allocator
+    high-water has no reset API, so the first reading is the remat peak
     and any rise after the remat=False run is attributable to keeping
     activations alive."""
     import dataclasses
 
     from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.obs import costmodel
 
     rng = np.random.default_rng(0)
     # CPU smoke uses a single-dispatch step: XLA CPU executes scanned conv
     # steps pathologically slowly (see Experiment._train warning)
     batch, k_steps, repeats = (4096, 4, 2) if on_tpu else (16, 0, 1)
+    ledger = costmodel.CostLedger()
+    costmodel.set_cost_ledger(ledger)
+    timings = {}
     out = {}
     for remat in (True, False):
         cfg = dataclasses.replace(policy_cnn.CONFIGS["large"], remat=remat)
         key = f"remat_{str(remat).lower()}"
+        # the AOT bill first: it exists even when the measured run OOMs
+        # (that IS the trade this mode probes)
+        entry = costmodel.train_entry(ledger, cfg, batch,
+                                      fn_name=f"train_step:{key}")
+        hbm_mb = (round(entry.hbm_peak_bytes / 2**20, 1)
+                  if entry.hbm_peak_bytes is not None else None)
         # one setting OOMing (the very trade this probes — remat=False at
         # big batch sits near a v5e's HBM) must not discard the other
         # setting's numbers or the one-JSON-line driver contract
         try:
             sps, ms_per_step = _time_train_step(cfg, batch, k_steps,
                                                 repeats, rng)
+            timings[(f"train_step:{key}", batch)] = ms_per_step / 1000.0
             out[key] = {
                 "samples_per_sec": round(sps, 1),
                 "ms_per_step": round(ms_per_step, 3),
+                "hbm_peak_mb": hbm_mb,
                 "peak_mem_mb_cumulative": _peak_mem_mb(),
             }
         except Exception as e:  # RESOURCE_EXHAUSTED and kin
             out[key] = {
                 "error": f"{type(e).__name__}: {str(e)[:300]}",
+                "hbm_peak_mb": hbm_mb,
                 "peak_mem_mb_cumulative": _peak_mem_mb(),
             }
     # headline prefers the remat=False number, falls back to remat=True if
@@ -494,6 +534,8 @@ def _bench_large(on_tpu: bool) -> dict:
             "vs_baseline": None,
             "error": "both remat settings failed",
             "settings": out,
+            # the AOT bill survives a double OOM — it is the diagnosis
+            "roofline": ledger.roofline(timings),
         }
     return {
         "metric": "large_training_samples_per_sec_per_chip",
@@ -504,6 +546,7 @@ def _bench_large(on_tpu: bool) -> dict:
         "steps_per_call": k_steps,
         "config": "13L/256",
         "settings": out,
+        "roofline": ledger.roofline(timings),
     }
 
 
@@ -588,6 +631,25 @@ def _apply_gate(result: dict, args) -> None:
                 verdict="fail",
                 reason=f"{ssc} steady-state compile(s) post-warmup — the "
                        "zero-recompile contract is broken "
+                       f"(was: {result['gate'].get('reason')})")
+    # the MFU floor folds in next to the throughput verdict: a run that
+    # "won" its boards/sec gate by spending hardware efficiency (bigger
+    # pads, silent f32 fallback, a dropped fusion) fails here. Skipped
+    # when the gate itself skipped (device mismatch / no baseline) —
+    # cross-device MFU ratios are no more a regression than cross-device
+    # throughput ratios (obs/costmodel.evaluate_mfu_floor).
+    if result["gate"].get("verdict") != "skip":
+        from deepgo_tpu.obs.costmodel import evaluate_mfu_floor
+
+        mfu = evaluate_mfu_floor(result.get("roofline"),
+                                 (entry or {}).get("roofline"),
+                                 floor=args.gate)
+        result["gate"]["mfu_floor"] = mfu
+        if mfu["verdict"] == "fail" \
+                and result["gate"].get("verdict") != "fail":
+            result["gate"].update(
+                verdict="fail",
+                reason=f"MFU floor: {mfu['reason']} "
                        f"(was: {result['gate'].get('reason')})")
 
 
@@ -1025,6 +1087,18 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     params = policy_cnn.init(jax.random.key(0), cfg)
     forward = make_log_prob_fn(cfg)
     ecfg = EngineConfig(buckets=buckets, max_wait_ms=2.0)
+    # the AOT device cost ledger (obs/costmodel.py): price every ladder
+    # rung of THE SAME jitted forward the engines will dispatch — before
+    # any engine exists, entirely outside the timed window below (the
+    # zero-per-dispatch-cost discipline; `aot_seconds` in the roofline
+    # block is the receipt). After the run, the per-bucket dispatch
+    # histogram divides each rung's FLOPs into achieved FLOP/s and MFU.
+    from deepgo_tpu.obs import costmodel
+
+    cost_ledger = costmodel.CostLedger()
+    costmodel.ladder_entries(cost_ledger, cfg, buckets=buckets,
+                             forward=forward)
+    costmodel.set_cost_ledger(cost_ledger)
     # request-scoped tracing rides the whole run (obs/tracing.py): every
     # submit gets a timeline, tail exemplars stream to trace.jsonl next
     # to the flight dumps, and the JSON proves no-orphan completeness +
@@ -1346,6 +1420,16 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         if xlacheck_report is not None:
             result["xlacheck"] = xlacheck_report
     result["tracing"] = tracing_block
+    # per-rung roofline: the AOT ladder ledger joined with the measured
+    # per-bucket dispatch means (deepgo_serving_dispatch_seconds{bucket})
+    # — achieved FLOP/s, MFU, and the bound class for every rung the run
+    # actually hit; rungs it never dispatched stay AOT-only (mfu null)
+    from deepgo_tpu.obs import get_registry
+
+    rung_secs = costmodel.dispatch_seconds_by_bucket(
+        get_registry().snapshot()["metrics"])
+    result["roofline"] = cost_ledger.roofline(
+        {("policy_forward", b): s for b, s in rung_secs.items()})
     if errors:
         result["error"] = "; ".join(sorted(set(errors))[:3])
     return result
@@ -1506,6 +1590,17 @@ def main() -> None:
     boards_per_sec = k_batches * batch / dt
 
     watchdog.disarm()
+    # the headline program's roofline: the whole K-batch scan is ONE
+    # jitted entrypoint — lower it AOT (cost_analysis FLOPs over all K
+    # forwards), divide by the measured median, and the inference
+    # ceiling finally has an MFU number instead of a boards/sec proxy
+    from deepgo_tpu.obs import costmodel
+
+    cost_ledger = costmodel.CostLedger()
+    costmodel.set_cost_ledger(cost_ledger)
+    cost_ledger.measure(
+        "inference_scan", fn, (params, *data), bucket=k_batches * batch,
+        analytic=costmodel.analytic_flops(cfg, k_batches * batch))
     result = {
         "metric": "policy_inference_boards_per_sec_per_chip",
         "value": round(boards_per_sec, 1),
@@ -1520,6 +1615,8 @@ def main() -> None:
         "noise_frac": round((max(times) - min(times)) / dt, 4)
         if len(times) > 1 else 0.0,
         "probe": probe,
+        "roofline": cost_ledger.roofline(
+            {("inference_scan", k_batches * batch): dt}),
     }
     if on_tpu:
         _record_last_good(result)

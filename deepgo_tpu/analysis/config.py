@@ -105,9 +105,10 @@ class LintConfig:
     # event/metric prefixes the drift checker enforces bidirectionally;
     # the first entry MUST stay "deepgo_" (the metric namespace — the
     # rest are JSONL event-kind namespaces). trace_* (request exemplars)
-    # and lineage_* (the loop provenance chain) joined in ISSUE 10.
+    # and lineage_* (the loop provenance chain) joined in ISSUE 10;
+    # cost_* (the AOT device cost ledger) in ISSUE 12.
     grammar_prefixes: tuple = ("deepgo_", "obs_", "loop_", "fleet_",
-                               "trace_", "lineage_")
+                               "trace_", "lineage_", "cost_")
     # doc tokens that share a grammar prefix but are not metrics/events:
     # bench JSON keys and similar
     grammar_ignore: frozenset = frozenset({

@@ -25,6 +25,12 @@
                                        chain (games -> segments -> window
                                        -> gate -> champion) from a run
                                        directory's JSONL streams
+  python -m deepgo_tpu.cli cost        AOT device cost ledger: lower +
+                                       compile every jitted entrypoint of
+                                       one model config and print its
+                                       FLOPs / bytes / HBM bill with the
+                                       platform roofline verdict
+                                       (docs/observability.md)
   python -m deepgo_tpu.cli lint        invariant linter: machine-check the
                                        atomic-write/determinism/thread/
                                        typed-error disciplines and the
@@ -364,6 +370,30 @@ def cmd_trace(args) -> None:
     print(trace_report(args.run_dir, args.id))
 
 
+def cmd_cost(args) -> None:
+    """The AOT device cost ledger (obs/costmodel.py): every jitted
+    entrypoint of one model config — the serving bucket ladder, the
+    8-fold sym ensemble, the fused train/eval steps — lowered and
+    compiled ahead of time, with XLA's FLOPs / bytes-accessed / HBM bill
+    and the compute-vs-memory roofline verdict per entrypoint. Nothing
+    executes (``jax.eval_shape`` avals in, ``cost_analysis()`` out), so
+    the sweep allocates no device buffers. Backends without a cost model
+    degrade to analytic-estimator rows marked ``estimated``."""
+    import json as _json
+
+    from .obs import costmodel
+
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    ledger = costmodel.standard_ledger(
+        model=args.model, buckets=buckets, train_batch=args.train_batch,
+        sym_bucket=args.sym_bucket)
+    costmodel.set_cost_ledger(ledger)
+    if args.json:
+        print(_json.dumps(ledger.roofline(), indent=1, default=str))
+    else:
+        print(costmodel.format_ledger(ledger))
+
+
 def cmd_lint(args) -> None:
     """Invariant linter + grammar drift checker (docs/static_analysis.md).
 
@@ -612,6 +642,28 @@ def main(argv=None) -> None:
                    help="learner ExperimentConfig overrides (model size, "
                         "batch_size, rate, ... — the train grammar)")
     p.set_defaults(fn=cmd_loop)
+
+    p = sub.add_parser("cost", help="AOT device cost ledger: FLOPs / "
+                                    "bytes / HBM per jitted entrypoint "
+                                    "plus the roofline bound class vs "
+                                    "the detected platform peak — "
+                                    "nothing executes on the device "
+                                    "(docs/observability.md)")
+    p.add_argument("--model", default="full",
+                   help="model config to price (small/medium/full/large; "
+                        "default full — the flagship 12L/128)")
+    p.add_argument("--buckets", default="1,8,32,128,512",
+                   help="serving-ladder rungs to price (CSV)")
+    p.add_argument("--train-batch", type=int, default=256, metavar="B",
+                   help="batch for the train/eval step programs "
+                        "(0 skips them — their backward-pass compile "
+                        "dominates the sweep on CPU)")
+    p.add_argument("--sym-bucket", type=int, default=8, metavar="B",
+                   help="batch for the 8-fold sym-ensemble forward "
+                        "(0 skips)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the roofline block as JSON")
+    p.set_defaults(fn=cmd_cost)
 
     p = sub.add_parser("lint", help="invariant linter: atomic-write/"
                        "determinism/thread/typed-error discipline + "

@@ -111,6 +111,11 @@ class ExperimentConfig:
     seed: int = 0
     run_dir: str = "runs"
     profile: bool = False  # capture a jax.profiler trace of train() into the run dir
+    # AOT device cost ledger (obs/costmodel.py): price this run's train
+    # step (XLA FLOPs / bytes / HBM) at train start, so the attribution
+    # report carries MFU. One extra XLA compile before the loop — zero
+    # per-step cost; identical programs are memoized process-wide
+    cost_ledger: bool = True
     # robustness (docs/robustness.md): rolling retention keeps the newest
     # N checkpoint-{step}.npz files plus the best-validation one (0 = keep
     # everything); ``faults`` installs a fault-injection plan in the
@@ -366,6 +371,24 @@ class Experiment:
         flight = get_flight_recorder()
         if not flight.enabled:
             flight = configure_flight(self.run_path)
+        if cfg.cost_ledger:
+            # price THIS run's step program ahead of time: the ledger
+            # gauges ride the close-time obs_snapshot, so the offline
+            # attribution join (cli obs) reports MFU without ever seeing
+            # this machine. AOT-only — the loop below never touches it.
+            from ..obs import costmodel
+
+            try:
+                ledger = costmodel.CostLedger(registry=reg, sink=metrics)
+                costmodel.train_entry(
+                    ledger, self.model_cfg, cfg.batch_size,
+                    optimizer=self.optimizer, wire=self.wire,
+                    augment=cfg.augment)
+                costmodel.set_cost_ledger(ledger)
+            except Exception as e:  # noqa: BLE001 — observability never
+                # blocks training; a backend that cannot even lower the
+                # step still trains, just without an MFU row
+                print(f"cost ledger: skipped ({e!r})", flush=True)
         dispatched_programs: set = set()  # phase=first vs phase=steady
         # validation data: fixed and game-balanced (improves on the
         # reference's one random minibatch per run, train.lua:62-67)

@@ -28,6 +28,11 @@ plus the analysis-and-enforcement layer on top (ISSUE 6):
   * ``sentinel``  — the noise-aware bench regression gate
     (``bench.py --gate`` vs BENCH_LAST_GOOD.json) and the ring-buffer
     crash flight recorder dumped on restart/HostLost/fast-burn/watchdog;
+  * ``costmodel`` — the AOT device cost ledger: every jitted entrypoint
+    lowered + compiled ahead of time, XLA ``cost_analysis()`` FLOPs /
+    bytes and ``memory_analysis()`` HBM folded into ``deepgo_cost_*``
+    gauges, ``cost_ledger`` events, the exporter's ``/cost`` route, and
+    the per-entrypoint roofline/MFU join ``bench --gate`` enforces;
   * ``tracing``   — request-scoped end-to-end timelines through the
     serving path (queued/routed/coalesced/dispatched/resolved + failover
     hops, one trace id surviving restarts), bounded-memory tail-exemplar
@@ -59,3 +64,8 @@ from .slo import (GaugeFloorObjective, HealthObjective,  # noqa: F401
                   parse_slo_spec)
 from .attribution import (attribute_run, attribute_snapshot,  # noqa: F401
                           format_attribution)
+from .costmodel import (CostEntry, CostLedger, PlatformPeak,  # noqa: F401
+                        analytic_flops, analytic_train_flops, detect_peak,
+                        dispatch_seconds_by_bucket, evaluate_mfu_floor,
+                        format_ledger, get_cost_ledger, set_cost_ledger,
+                        standard_ledger)

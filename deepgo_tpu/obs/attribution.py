@@ -36,6 +36,12 @@ per-host decompositions and reports the FireCaffe-style scaling view:
 per-host samples/sec, fleet aggregate, and the per-host non-compute
 fractions that bound scaling efficiency.
 
+When the snapshot carries the AOT cost-ledger gauges (obs/costmodel.py —
+the train loop measures its own step program at start), the decomposition
+gains a ``roofline`` block: achieved FLOP/s and MFU against the recorded
+platform peak, so "the compute bucket is 60% of wall" and "that compute
+ran at 4% MFU" finally live in one table.
+
 Consumers: ``cli obs`` (the per-stage report grows an attribution table)
 and ``bench.py --mode distributed`` (the BENCH json gains an
 ``attribution`` field).
@@ -93,6 +99,57 @@ def _series_sum(metrics: dict, name: str, where: dict | None = None,
     return total
 
 
+def _series_one(metrics: dict, name: str, where: dict | None = None):
+    """First matching series value (gauges/counters are bare numbers in a
+    snapshot) or None — for metrics that carry exactly one relevant
+    series, where summing label sets would double count."""
+    m = metrics.get(name)
+    if not m:
+        return None
+    for label, value in m.get("series", {}).items():
+        if where is not None:
+            labels = _parse_label(label)
+            if any(labels.get(k) != str(v) for k, v in where.items()):
+                continue
+        if value is not None and not isinstance(value, dict):
+            return float(value)
+    return None
+
+
+def _roofline_from_snapshot(metrics: dict, wall: float,
+                            steps: float) -> dict | None:
+    """The MFU join: the train step's AOT cost-ledger gauges (written by
+    Experiment at train start, obs/costmodel.py) against the measured
+    wall-clock and step count of the same snapshot. The ledger rides in
+    the snapshot itself — including the detected platform peak — so the
+    join works offline on another machine (`cli obs` over a copied run
+    dir) without re-detecting hardware it cannot see. MFU is against ONE
+    chip's peak — on a data-parallel host the ratio reads as host-level
+    utilization only when the whole batch fit one chip's program."""
+    flops = _series_one(metrics, "deepgo_cost_flops", {"fn": "train_step"})
+    if not flops or not steps or not wall:
+        return None
+    achieved = flops * steps / wall
+    out = {
+        "flops_per_step": flops,
+        "achieved_flops_per_s": round(achieved),
+    }
+    peak = _series_one(metrics, "deepgo_cost_peak_flops_per_sec")
+    bw = _series_one(metrics, "deepgo_cost_peak_hbm_bytes_per_sec")
+    bytes_ = _series_one(metrics, "deepgo_cost_bytes", {"fn": "train_step"})
+    out["mfu"] = round(achieved / peak, 6) if peak else None
+    if bytes_:
+        ai = flops / bytes_
+        out["arithmetic_intensity"] = round(ai, 3)
+        if peak and bw:
+            out["bound"] = "compute" if ai >= peak / bw else "memory"
+    hbm = _series_one(metrics, "deepgo_cost_hbm_peak_bytes",
+                      {"fn": "train_step"})
+    if hbm is not None:
+        out["hbm_peak_bytes"] = hbm
+    return out
+
+
 def attribute_snapshot(metrics: dict) -> dict | None:
     """Decompose one registry snapshot's train wall-clock into buckets.
 
@@ -136,6 +193,9 @@ def attribute_snapshot(metrics: dict) -> dict | None:
     }
     if samples and wall:
         out["samples_per_sec"] = round(samples / wall, 1)
+    roofline = _roofline_from_snapshot(metrics, wall, steps)
+    if roofline is not None:
+        out["roofline"] = roofline
     # h2d paid off the consumer's clock (uploader thread) overlaps with
     # compute — outside the decomposition, reported for completeness
     overlapped = _series_sum(metrics, "deepgo_h2d_seconds",
@@ -234,6 +294,16 @@ def format_attribution(att: dict) -> str:
         if a.get("samples_per_sec"):
             extra += f", {a['samples_per_sec']:.0f} samples/sec"
         lines.append(extra)
+        roof = a.get("roofline")
+        if roof:
+            mfu = (f"MFU {roof['mfu']:.2%}" if roof.get("mfu") is not None
+                   else "MFU unknown (no platform peak)")
+            line = (f"  host{h} roofline: {mfu}, "
+                    f"{roof['achieved_flops_per_s'] / 1e9:.1f} GFLOP/s "
+                    "achieved")
+            if roof.get("bound"):
+                line += f", {roof['bound']}-bound"
+            lines.append(line)
     scaling = att.get("scaling")
     if scaling:
         lines.append(

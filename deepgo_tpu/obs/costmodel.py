@@ -1,0 +1,718 @@
+"""Device cost-model ledger: AOT roofline / MFU attribution per entrypoint.
+
+The host-side observability stack (attribution, SLOs, tracing) says where
+*wall-clock* went, but nothing in the repo could say whether the compute
+bucket was anywhere near the hardware roofline, whether a bucket-ladder
+rung is compute- or memory-bound, or how much HBM a config needs before
+it OOMs a chip — exactly the per-resource attribution FireCaffe
+(arXiv:1511.00175) argues you need before optimizing anything. This
+module closes that gap with the compiler's own numbers:
+
+  * every jitted entrypoint (each ladder rung of the serving forward,
+    the train/eval steps, the sym-ensemble forward) is lowered and
+    compiled **ahead of time** — ``jax.jit(...).lower(...).compile()``
+    over ``jax.eval_shape`` avals, so no device buffers are allocated
+    and nothing runs — and XLA's ``cost_analysis()`` FLOPs +
+    bytes-accessed and ``memory_analysis()`` argument/output/temp HBM
+    land in a typed :class:`CostEntry`;
+  * entries publish ``deepgo_cost_*`` gauges into the PR 5 registry and
+    stream versioned ``cost_ledger`` JSONL events, so the offline report
+    and the live ``/cost`` exporter route both see them;
+  * :meth:`CostLedger.roofline` joins the AOT ledger with *measured*
+    timings (bench medians, the engine's per-bucket dispatch histogram,
+    the train loop's step counters) into achieved FLOP/s, **MFU**
+    against a detected per-platform peak, arithmetic intensity, and a
+    compute-vs-memory-bound verdict per entrypoint;
+  * ``bench.py`` folds that join into every mode's JSON as a
+    ``roofline`` block, and ``bench --gate`` runs
+    :func:`evaluate_mfu_floor` so a perf PR that "wins" its throughput
+    gate by silently dropping MFU still fails.
+
+Discipline (the lockcheck/xlacheck pattern): ALL analysis is AOT at
+warmup/bench/train-start time — the dispatch hot path never sees this
+module. Backends with no cost model (or where lowering itself fails)
+degrade gracefully: the row is marked ``source="estimated"`` and carries
+the analytic FLOPs estimator's number instead of crashing (CPU CI runs
+the same code paths as a TPU capture).
+
+Caveat worth stating once: XLA's ``bytes accessed`` is per-op traffic,
+not a cache-aware HBM model, so arithmetic intensity is an upper bound
+on memory pressure; and the analytic estimator counts SAME-padding
+border taps exactly the way XLA does (a dense ``k²·cin·cout·361``
+count overstates a 19x19 board's conv FLOPs by ~10%).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+
+from ..analysis.lockcheck import make_lock
+from .registry import MetricsRegistry, get_registry
+
+# bumped when CostEntry/event fields change shape; rides in every
+# cost_ledger event and roofline block so offline joins can dispatch
+VERSION = 1
+
+# bf16 peak FLOP/s, HBM bandwidth (bytes/s), HBM capacity (bytes) per
+# chip, matched by substring against jax's device_kind (public Google
+# specs; v5e is what BASELINE.md targets). First match wins, so the
+# more specific kinds sort first.
+_TPU_PEAKS = (
+    ("v6e", 918e12, 1640e9, 32 * 2**30),
+    ("v6 lite", 918e12, 1640e9, 32 * 2**30),
+    ("v5p", 459e12, 2765e9, 95 * 2**30),
+    ("v5e", 197e12, 819e9, 16 * 2**30),
+    ("v5 lite", 197e12, 819e9, 16 * 2**30),
+    ("v4", 275e12, 1228e9, 32 * 2**30),
+    ("v3", 123e12, 900e9, 32 * 2**30),
+    ("v2", 45e12, 700e9, 16 * 2**30),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlatformPeak:
+    """The roofline's ceiling for one device. ``source`` says how much to
+    trust it: "table" (a known TPU generation), "estimated" (the CPU
+    fallback: core count x a nominal per-core FMA rate, so CI exercises
+    the full join with honest quotation marks), or "unknown" (an
+    unrecognized accelerator — MFU reads None rather than lying)."""
+
+    platform: str
+    device_kind: str
+    flops_per_s: float | None
+    hbm_bytes_per_s: float | None
+    hbm_capacity_bytes: float | None
+    source: str
+
+    @property
+    def ridge_flops_per_byte(self) -> float | None:
+        """The roofline ridge point: arithmetic intensity above which the
+        ceiling is compute, below which it is memory bandwidth."""
+        if self.flops_per_s and self.hbm_bytes_per_s:
+            return self.flops_per_s / self.hbm_bytes_per_s
+        return None
+
+    def to_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        ridge = self.ridge_flops_per_byte
+        out["ridge_flops_per_byte"] = round(ridge, 3) if ridge else None
+        return out
+
+
+def detect_peak(device=None) -> PlatformPeak:
+    """The per-platform peak for ``device`` (default: the first local
+    device). TPU generations come from the table above; CPU gets an
+    estimated peak so the MFU plumbing runs everywhere; anything else is
+    "unknown" with None ceilings."""
+    if device is None:
+        import jax
+
+        device = jax.local_devices()[0]
+    platform = getattr(device, "platform", "unknown")
+    kind = str(getattr(device, "device_kind", "") or "")
+    low = kind.lower()
+    for sub, flops, bw, cap in _TPU_PEAKS:
+        if sub in low:
+            return PlatformPeak(platform, kind, flops, bw, cap, "table")
+    if platform == "cpu":
+        # nominal modern x86 core: 2 FMA ports x 8 f32 lanes x 2 flops x
+        # ~2 GHz = 64 GFLOP/s/core; ~3 GB/s/core sustained memory BW.
+        # Deliberately coarse — the point is exercising the join, and the
+        # "estimated" source tag rides every derived MFU.
+        cores = os.cpu_count() or 1
+        try:
+            capacity = float(os.sysconf("SC_PHYS_PAGES")
+                             * os.sysconf("SC_PAGE_SIZE"))
+        except (ValueError, OSError, AttributeError):
+            capacity = None
+        return PlatformPeak(platform, kind or "cpu", cores * 64e9,
+                            cores * 3e9, capacity, "estimated")
+    return PlatformPeak(platform, kind, None, None, None, "unknown")
+
+
+# ---------------------------------------------------------------------------
+# the analytic estimator (the degraded-mode fallback and the cross-check)
+
+
+def _same_taps(size: int, k: int) -> int:
+    """Sum over one spatial dim's output positions of the kernel taps that
+    land inside a SAME-padded input of ``size`` — the count XLA actually
+    charges for border outputs (a dense k·size count overcharges them)."""
+    half = k // 2
+    return sum(min(i + half, size - 1) - max(i - half, 0) + 1
+               for i in range(size))
+
+
+def analytic_flops(cfg, batch: int = 1) -> float:
+    """Forward-pass conv FLOPs (MAC x 2) of one ``policy_cnn.ModelConfig``
+    for ``batch`` 19x19 boards, counting SAME-padding border taps exactly
+    as XLA's cost model does. Replaces bench.py's hand-rolled
+    ``_conv_flops_per_sample``, whose dense ``k²·cin·cout·361`` count
+    overstated the 19x19 stack by ~10% (tests/test_costmodel.py pins this
+    formula against ``cost_analysis()`` to a tolerance band). Bias adds,
+    ReLUs, and the plane expansion are excluded — sub-1% at these widths.
+    """
+    from .. import BOARD_SIZE
+
+    total = 0.0
+    for k, c_in, c_out in cfg.layer_shapes():
+        taps = _same_taps(BOARD_SIZE, k)
+        total += 2.0 * c_in * c_out * taps * taps
+    return batch * total
+
+
+def analytic_train_flops(cfg, batch: int = 1) -> float:
+    """Fused train-step estimate: forward + backward ~= 3x forward (the
+    standard estimate bench.py has always quoted for ``tflops_est``)."""
+    return 3.0 * analytic_flops(cfg, batch)
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+
+
+@dataclasses.dataclass(frozen=True)
+class CostEntry:
+    """One AOT-compiled entrypoint's resource bill. ``source="xla"`` rows
+    carry the compiler's own numbers; ``"estimated"`` rows mean the
+    backend returned no cost model (or lowering failed) and ``flops`` is
+    the analytic estimator's count with byte/HBM fields None."""
+
+    fn: str
+    bucket: int | None
+    flops: float
+    bytes_accessed: float | None
+    hbm_peak_bytes: float | None
+    hbm_argument_bytes: float | None
+    hbm_output_bytes: float | None
+    hbm_temp_bytes: float | None
+    compile_seconds: float
+    source: str
+    platform: str
+
+    @property
+    def key(self) -> str:
+        return self.fn if self.bucket is None else f"{self.fn}/b{self.bucket}"
+
+    @property
+    def arithmetic_intensity(self) -> float | None:
+        if self.flops and self.bytes_accessed:
+            return self.flops / self.bytes_accessed
+        return None
+
+    def to_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        ai = self.arithmetic_intensity
+        out["arithmetic_intensity"] = round(ai, 3) if ai else None
+        return out
+
+
+def _normalize_cost(cost) -> dict:
+    """``Compiled.cost_analysis()`` returns a dict on new jax, a
+    one-element list of dicts on older — normalize to one dict."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
+class CostLedger:
+    """The process ledger: measured entries + gauges + events + roofline.
+
+    Thread-safe the repo's way (one lock via make_lock), but the intended
+    use is single-threaded AOT passes at warmup/bench/train-start — the
+    lock is for the exporter's ``/cost`` reads racing a slow build.
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None, sink=None,
+                 device=None, clock=time.monotonic):
+        self._registry = registry or get_registry()
+        self._sink = sink  # anything with .write(kind, **fields), or None
+        self._clock = clock
+        self._lock = make_lock("obs.costmodel")
+        self._entries: list[CostEntry] = []
+        self._aot_seconds = 0.0
+        self.peak = detect_peak(device)
+        reg = self._registry
+        self._g_flops = reg.gauge(
+            "deepgo_cost_flops",
+            "AOT cost-model FLOPs of one jitted entrypoint dispatch")
+        self._g_bytes = reg.gauge(
+            "deepgo_cost_bytes",
+            "AOT cost-model bytes accessed per dispatch")
+        self._g_hbm = reg.gauge(
+            "deepgo_cost_hbm_peak_bytes",
+            "AOT device-memory bill (argument+output+temp) per entrypoint")
+        self._g_compile = reg.gauge(
+            "deepgo_cost_compile_seconds",
+            "wall time of the AOT lower+compile per entrypoint")
+        self._g_peak_flops = reg.gauge(
+            "deepgo_cost_peak_flops_per_sec",
+            "detected per-platform peak FLOP/s (the MFU denominator)")
+        self._g_peak_bw = reg.gauge(
+            "deepgo_cost_peak_hbm_bytes_per_sec",
+            "detected per-platform HBM bandwidth (the roofline slope)")
+        if self.peak.flops_per_s:
+            self._g_peak_flops.set(self.peak.flops_per_s,
+                                   platform=self.peak.platform,
+                                   source=self.peak.source)
+        if self.peak.hbm_bytes_per_s:
+            self._g_peak_bw.set(self.peak.hbm_bytes_per_s,
+                                platform=self.peak.platform,
+                                source=self.peak.source)
+
+    # -- building ----------------------------------------------------------
+
+    def measure(self, fn: str, jitted, args: tuple, kwargs: dict | None = None,
+                *, bucket: int | None = None,
+                analytic: float | None = None) -> CostEntry:
+        """Lower + compile ``jitted`` at ``args``' avals and record its
+        bill. ``args`` may be concrete arrays or ``jax.ShapeDtypeStruct``
+        pytrees (``jax.eval_shape`` output) — AOT either way: nothing
+        executes, no device buffers are written.
+
+        Never raises for backend reasons: a backend with no cost model,
+        or a ``lower()``/``compile()`` failure, degrades the row to
+        ``source="estimated"`` with ``analytic`` FLOPs (0.0 when no
+        estimator was given — still a row, still honest)."""
+        t0 = self._clock()
+        flops = bytes_accessed = None
+        hbm_arg = hbm_out = hbm_tmp = hbm_peak = None
+        try:
+            compiled = jitted.lower(*args, **(kwargs or {})).compile()
+            cost = _normalize_cost(compiled.cost_analysis())
+            flops = float(cost.get("flops") or 0.0) or None
+            bytes_accessed = float(cost.get("bytes accessed") or 0.0) or None
+            try:
+                mem = compiled.memory_analysis()
+            except Exception:  # noqa: BLE001 — per-backend, optional
+                mem = None
+            if mem is not None:
+                hbm_arg = float(getattr(mem, "argument_size_in_bytes", 0.0))
+                hbm_out = float(getattr(mem, "output_size_in_bytes", 0.0))
+                hbm_tmp = float(getattr(mem, "temp_size_in_bytes", 0.0))
+                alias = float(getattr(mem, "alias_size_in_bytes", 0.0))
+                code = float(getattr(mem, "generated_code_size_in_bytes",
+                                     0.0))
+                # donated buffers alias outputs — they are not billed twice
+                hbm_peak = max(0.0, hbm_arg + hbm_out + hbm_tmp + code
+                               - alias)
+        except Exception:  # noqa: BLE001 — degraded mode, never crash
+            pass
+        compile_seconds = self._clock() - t0
+        source = "xla"
+        if flops is None:
+            source = "estimated"
+            flops = float(analytic or 0.0)
+        entry = CostEntry(
+            fn=fn, bucket=bucket, flops=flops,
+            bytes_accessed=bytes_accessed, hbm_peak_bytes=hbm_peak,
+            hbm_argument_bytes=hbm_arg, hbm_output_bytes=hbm_out,
+            hbm_temp_bytes=hbm_tmp,
+            compile_seconds=round(compile_seconds, 4), source=source,
+            platform=self.peak.platform)
+        self.add(entry)
+        return entry
+
+    def add(self, entry: CostEntry) -> None:
+        """Record one entry: ledger row + gauges + the JSONL event."""
+        with self._lock:
+            self._entries.append(entry)
+            self._aot_seconds += entry.compile_seconds
+        labels = {"fn": entry.fn}
+        if entry.bucket is not None:
+            labels["bucket"] = entry.bucket
+        self._g_flops.set(entry.flops, **labels)
+        if entry.bytes_accessed is not None:
+            self._g_bytes.set(entry.bytes_accessed, **labels)
+        if entry.hbm_peak_bytes is not None:
+            self._g_hbm.set(entry.hbm_peak_bytes, **labels)
+        self._g_compile.set(entry.compile_seconds, **labels)
+        if self._sink is not None:
+            try:
+                # entry.to_dict() already carries the platform
+                self._sink.write("cost_ledger", version=VERSION,
+                                 device_kind=self.peak.device_kind,
+                                 **entry.to_dict())
+            except Exception:  # noqa: BLE001 — bookkeeping never fatal
+                pass
+
+    # -- reading -----------------------------------------------------------
+
+    @property
+    def entries(self) -> list[CostEntry]:
+        with self._lock:
+            return list(self._entries)
+
+    def get(self, fn: str, bucket: int | None = None) -> CostEntry | None:
+        with self._lock:
+            for e in self._entries:
+                if e.fn == fn and e.bucket == bucket:
+                    return e
+        return None
+
+    @property
+    def aot_seconds(self) -> float:
+        with self._lock:
+            return round(self._aot_seconds, 3)
+
+    def to_dict(self) -> dict:
+        return {
+            "version": VERSION,
+            "platform": self.peak.platform,
+            "device_kind": self.peak.device_kind,
+            "peak": self.peak.to_dict(),
+            "aot_seconds": self.aot_seconds,
+            "entries": [e.to_dict() for e in self.entries],
+        }
+
+    def roofline(self, timings: dict | None = None) -> dict:
+        """The ledger joined with measured timings.
+
+        ``timings`` maps ``(fn, bucket) -> seconds per dispatch`` (bench
+        medians, per-bucket dispatch means, per-step wall). Entries with
+        a timing gain ``achieved_flops_per_s`` + ``mfu``; the rest stay
+        AOT-only (``mfu: None``) — the block shape is identical either
+        way so gates and dashboards need no special cases."""
+        timings = timings or {}
+        entries = {}
+        for e in self.entries:
+            entries[e.key] = roofline_entry(
+                e, self.peak, seconds_per_call=timings.get((e.fn, e.bucket)))
+        return {
+            "version": VERSION,
+            "platform": self.peak.platform,
+            "device_kind": self.peak.device_kind,
+            "peak": self.peak.to_dict(),
+            "aot_seconds": self.aot_seconds,
+            "entries": entries,
+        }
+
+
+def roofline_entry(entry: CostEntry, peak: PlatformPeak,
+                   seconds_per_call: float | None = None) -> dict:
+    """One entrypoint's roofline verdict: the acceptance shape
+    ``{flops, bytes, hbm_peak, achieved_flops_per_s, mfu, bound}`` plus
+    the arithmetic the verdict came from."""
+    ai = entry.arithmetic_intensity
+    ridge = peak.ridge_flops_per_byte
+    bound = None
+    if ai is not None and ridge is not None:
+        bound = "compute" if ai >= ridge else "memory"
+    out = {
+        "flops": entry.flops,
+        "bytes": entry.bytes_accessed,
+        "hbm_peak": entry.hbm_peak_bytes,
+        "achieved_flops_per_s": None,
+        "mfu": None,
+        "bound": bound,
+        "arithmetic_intensity": round(ai, 3) if ai else None,
+        "compile_seconds": entry.compile_seconds,
+        "source": entry.source,
+    }
+    if peak.hbm_capacity_bytes and entry.hbm_peak_bytes is not None:
+        out["hbm_headroom_bytes"] = round(
+            peak.hbm_capacity_bytes - entry.hbm_peak_bytes)
+    if seconds_per_call and seconds_per_call > 0 and entry.flops:
+        achieved = entry.flops / seconds_per_call
+        out["achieved_flops_per_s"] = round(achieved)
+        out["seconds_per_call"] = round(seconds_per_call, 6)
+        if peak.flops_per_s:
+            out["mfu"] = round(achieved / peak.flops_per_s, 4)
+            # the entry's own ceiling: memory-bound entries cap below
+            # peak FLOP/s at ai x bandwidth
+            ceiling = peak.flops_per_s
+            if ai is not None and peak.hbm_bytes_per_s:
+                ceiling = min(ceiling, ai * peak.hbm_bytes_per_s)
+            out["roofline_frac"] = round(achieved / ceiling, 4)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entrypoint builders (every jitted program the repo serves or trains)
+
+
+def _board_avals(batch: int, wire: str = "packed"):
+    """ShapeDtypeStruct avals of one packed-record batch (no data, no
+    device buffers — the whole point of the AOT pass)."""
+    import jax
+
+    if wire == "nibble":
+        packed = jax.ShapeDtypeStruct((batch, 1625), np.uint8)
+    else:
+        packed = jax.ShapeDtypeStruct((batch, 9, 19, 19), np.uint8)
+    ints = jax.ShapeDtypeStruct((batch,), np.int32)
+    return packed, ints
+
+
+def _params_avals(cfg):
+    import functools
+
+    import jax
+
+    from ..models import policy_cnn
+
+    return jax.eval_shape(functools.partial(policy_cnn.init, cfg=cfg),
+                          jax.random.key(0))
+
+
+def ladder_entries(ledger: CostLedger, cfg, buckets=None, forward=None,
+                   fn_name: str = "policy_forward") -> list[CostEntry]:
+    """One entry per bucket-ladder rung of the serving forward
+    (``make_log_prob_fn`` unless ``forward`` is the engine's own jit) —
+    the AOT twin of ``InferenceEngine.warmup()``'s compile sweep."""
+    from ..models.serving import make_log_prob_fn
+    from ..serving.buckets import DEFAULT_BUCKETS
+
+    fn = forward if forward is not None else make_log_prob_fn(cfg)
+    params = _params_avals(cfg)
+    out = []
+    for b in sorted(set(int(x) for x in (buckets or DEFAULT_BUCKETS))):
+        packed, ints = _board_avals(b)
+        out.append(ledger.measure(
+            fn_name, fn, (params, packed, ints, ints), bucket=b,
+            analytic=analytic_flops(cfg, b)))
+    return out
+
+
+def sym_entry(ledger: CostLedger, cfg, bucket: int = 8,
+              fn_name: str = "sym_policy_forward") -> CostEntry:
+    """The 8-fold dihedral ensemble forward (``make_sym_policy_fn``) —
+    the ~8x-cost entrypoint ROADMAP item 1 wants fused; its ledger row is
+    the before picture that fusion PR will be gated against."""
+    from ..models.serving import make_sym_policy_fn
+
+    fn = make_sym_policy_fn(cfg)
+    packed, ints = _board_avals(bucket)
+    return ledger.measure(fn_name, fn, (_params_avals(cfg), packed, ints,
+                                        ints), bucket=bucket,
+                          analytic=8.0 * analytic_flops(cfg, bucket))
+
+
+# identical train-step programs are priced once per process: the
+# expert-iteration tests and loops build many short Experiments over the
+# same config, and re-lowering the same program would multiply the AOT
+# compile cost for bit-identical numbers
+_train_memo: dict[tuple, CostEntry] = {}
+
+
+def train_entry(ledger: CostLedger, cfg, batch: int, optimizer=None,
+                wire: str = "packed", augment: bool = False,
+                fn_name: str = "train_step") -> CostEntry:
+    """The fused single-step train program (``make_train_step``): one
+    optimizer step at ``batch`` — FLOPs per step are identical under the
+    K-step scan, so this one row prices both dispatch shapes."""
+    import jax
+
+    from ..training import make_train_step
+    from ..training.optimizers import OPTIMIZERS
+
+    memo_key = (fn_name, cfg, int(batch), wire, bool(augment),
+                type(optimizer).__name__, ledger.peak.platform)
+    cached = _train_memo.get(memo_key)
+    if cached is not None:
+        ledger.add(cached)
+        return cached
+    optimizer = optimizer or OPTIMIZERS["sgd"](0.01, 1e-7, 0.0)
+    step = make_train_step(cfg, optimizer, augment=augment, wire=wire)
+    params = _params_avals(cfg)
+    opt_state = jax.eval_shape(optimizer.init, params)
+    packed, ints = _board_avals(batch, wire)
+    batch_avals = {"packed": packed, "player": ints, "rank": ints,
+                   "target": ints}
+    if augment:
+        batch_avals["sym"] = ints
+    entry = ledger.measure(fn_name, step, (params, opt_state, batch_avals),
+                           bucket=batch,
+                           analytic=analytic_train_flops(cfg, batch))
+    _train_memo[memo_key] = entry
+    return entry
+
+
+def eval_entry(ledger: CostLedger, cfg, batch: int, wire: str = "packed",
+               fn_name: str = "eval_step") -> CostEntry:
+    """The validation program (``make_eval_step``)."""
+    from ..training import make_eval_step
+
+    step = make_eval_step(cfg, wire=wire)
+    packed, ints = _board_avals(batch, wire)
+    batch_avals = {"packed": packed, "player": ints, "rank": ints,
+                   "target": ints}
+    return ledger.measure(fn_name, step, (_params_avals(cfg), batch_avals),
+                          bucket=batch, analytic=analytic_flops(cfg, batch))
+
+
+def standard_ledger(model: str = "full", buckets=None,
+                    train_batch: int = 256, sym_bucket: int = 8,
+                    registry: MetricsRegistry | None = None,
+                    sink=None) -> CostLedger:
+    """The ``cli cost`` sweep: the serving ladder, the sym ensemble, and
+    the train/eval steps of one named model config, in one ledger.
+    ``train_batch=0`` skips the train/eval programs (their backward-pass
+    compile dominates the sweep on CPU)."""
+    from ..models import policy_cnn
+
+    cfg = policy_cnn.CONFIGS[model]
+    ledger = CostLedger(registry=registry, sink=sink)
+    ladder_entries(ledger, cfg, buckets=buckets)
+    if sym_bucket:
+        sym_entry(ledger, cfg, bucket=sym_bucket)
+    if train_batch:
+        train_entry(ledger, cfg, train_batch)
+        eval_entry(ledger, cfg, train_batch)
+    return ledger
+
+
+# ---------------------------------------------------------------------------
+# joins against measured timings
+
+
+def _parse_label(label: str) -> dict:
+    if not label:
+        return {}
+    out = {}
+    for part in label.split(","):
+        k, _, v = part.partition("=")
+        out[k] = v
+    return out
+
+
+def dispatch_seconds_by_bucket(metrics: dict) -> dict[int, float]:
+    """Mean coalesced-dispatch seconds per ladder rung, from the
+    ``deepgo_serving_dispatch_seconds{engine,bucket}`` histogram in a
+    registry snapshot (summed across engines — a fleet's replicas share
+    one jitted program, so their rungs price identically)."""
+    m = (metrics or {}).get("deepgo_serving_dispatch_seconds") or {}
+    sums: dict[int, float] = {}
+    counts: dict[int, int] = {}
+    for label, snap in (m.get("series") or {}).items():
+        if not isinstance(snap, dict):
+            continue
+        bucket = _parse_label(label).get("bucket")
+        if bucket is None:
+            continue
+        try:
+            b = int(bucket)
+        except ValueError:
+            continue
+        sums[b] = sums.get(b, 0.0) + float(snap.get("sum") or 0.0)
+        counts[b] = counts.get(b, 0) + int(snap.get("count") or 0)
+    return {b: sums[b] / counts[b] for b in sums if counts.get(b)}
+
+
+def evaluate_mfu_floor(fresh: dict | None, baseline: dict | None,
+                       floor: float = 0.10) -> dict:
+    """The MFU-floor gate: compare a fresh ``roofline`` block against the
+    last-good capture's, entry by entry. An entrypoint whose MFU dropped
+    by ``floor`` (relative) or more is a failure even when raw
+    throughput passed — a "win" that spends hardware efficiency is a
+    latent regression. Entries without MFU on either side (AOT-only
+    rows, unknown platforms) are skipped, never failed: the gate
+    enforces what it can measure (the ``evaluate_gate`` discipline)."""
+    out: dict = {"floor": floor, "checked": 0, "failures": []}
+    fresh_entries = (fresh or {}).get("entries") or {}
+    base_entries = (baseline or {}).get("entries") or {}
+    if not fresh_entries or not base_entries:
+        out.update(verdict="skip",
+                   reason="no roofline block on one side — nothing to "
+                          "compare")
+        return out
+    for key in sorted(set(fresh_entries) & set(base_entries)):
+        f_mfu = (fresh_entries[key] or {}).get("mfu")
+        b_mfu = (base_entries[key] or {}).get("mfu")
+        if not f_mfu or not b_mfu:
+            continue
+        out["checked"] += 1
+        drop = (b_mfu - f_mfu) / b_mfu
+        if drop >= floor:
+            out["failures"].append({
+                "entry": key, "mfu": f_mfu, "baseline_mfu": b_mfu,
+                "drop": round(drop, 4)})
+    if not out["checked"]:
+        out.update(verdict="skip",
+                   reason="no entrypoint carries MFU on both sides")
+    elif out["failures"]:
+        worst = max(out["failures"], key=lambda f: f["drop"])
+        out.update(verdict="fail",
+                   reason=f"{worst['entry']} MFU dropped {worst['drop']:.1%} "
+                          f"({worst['baseline_mfu']:.4f} -> "
+                          f"{worst['mfu']:.4f}), floor {floor:.0%} — "
+                          "throughput may have passed, hardware efficiency "
+                          "did not")
+    else:
+        out.update(verdict="pass",
+                   reason=f"MFU within floor on {out['checked']} "
+                          "entrypoint(s)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the process-wide ledger (what the exporter's /cost route serves)
+
+_ledger_lock = make_lock("obs.costmodel.global")
+_process_ledger: CostLedger | None = None
+
+
+def set_cost_ledger(ledger: CostLedger | None) -> None:
+    """Install (or clear) the process ledger. bench / `cli cost` /
+    Experiment install theirs so a live ``--obs-port`` serves it at
+    ``/cost`` next to ``/metrics``."""
+    global _process_ledger
+    with _ledger_lock:
+        _process_ledger = ledger
+
+
+def get_cost_ledger() -> CostLedger | None:
+    with _ledger_lock:
+        return _process_ledger
+
+
+# ---------------------------------------------------------------------------
+# rendering (cli cost / cli obs)
+
+
+def _fmt_num(v, scale=1.0, suffix="") -> str:
+    if v is None:
+        return "-"
+    return f"{v / scale:,.1f}{suffix}"
+
+
+def format_ledger(ledger: CostLedger, timings: dict | None = None) -> str:
+    """Fixed-width table of the ledger (+ roofline columns when timings
+    are supplied) — what ``cli cost`` prints."""
+    block = ledger.roofline(timings)
+    peak = block["peak"]
+    lines = [
+        f"device cost ledger v{block['version']} — {block['platform']} "
+        f"({block['device_kind']}), peak "
+        f"{_fmt_num(peak['flops_per_s'], 1e12)} TFLOP/s @ "
+        f"{_fmt_num(peak['hbm_bytes_per_s'], 1e9)} GB/s "
+        f"(ridge {peak['ridge_flops_per_byte'] or '-'} FLOP/byte, "
+        f"source: {peak['source']}); AOT {block['aot_seconds']}s",
+    ]
+    header = ["entrypoint", "GFLOPs", "MB moved", "AI", "HBM MB",
+              "compile_s", "bound", "MFU", "src"]
+    rows = []
+    for key, e in block["entries"].items():
+        rows.append([
+            key,
+            _fmt_num(e["flops"], 1e9),
+            _fmt_num(e["bytes"], 2**20),
+            f"{e['arithmetic_intensity']:.1f}"
+            if e["arithmetic_intensity"] else "-",
+            _fmt_num(e["hbm_peak"], 2**20),
+            f"{e['compile_seconds']:.2f}",
+            e["bound"] or "-",
+            f"{e['mfu']:.2%}" if e["mfu"] else "-",
+            e["source"],
+        ])
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              if rows else len(header[i]) for i in range(len(header))]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)

@@ -11,13 +11,14 @@ and files — nothing to ``pip install`` on the container):
     context-manager support, thread-safe writes, and size-based rotation
     (``path`` -> ``path.1`` -> ``path.2`` ...) so a chaos soak cannot
     grow one file without bound.
-  * ``ObsExporter`` — a daemon-thread HTTP server with two routes:
-    ``/metrics`` renders the registry in Prometheus text exposition
-    format (scrape it with curl or a real Prometheus), ``/healthz``
-    composes registered health callables (SupervisedEngine.health(),
-    HeartbeatLedger liveness, ...) into one JSON verdict: HTTP 200 when
-    every component is healthy, 503 the moment one is not — so a kill
-    injection flips the endpoint within the detector's own budget.
+  * ``ObsExporter`` — a daemon-thread HTTP server: ``/metrics`` renders
+    the registry in Prometheus text exposition format (scrape it with
+    curl or a real Prometheus), ``/healthz`` composes registered health
+    callables (SupervisedEngine.health(), HeartbeatLedger liveness, ...)
+    into one JSON verdict — HTTP 200 when every component is healthy,
+    503 the moment one is not, so a kill injection flips the endpoint
+    within the detector's own budget — plus ``/trace`` (the live
+    tail-exemplar ring) and ``/cost`` (the AOT device cost ledger).
 
 Port 0 binds an ephemeral port (tests); ``exporter.port`` reports the
 real one. The server thread is a daemon and ``close()`` is idempotent —
@@ -220,6 +221,18 @@ class _Handler(BaseHTTPRequestHandler):
             if rec is not None:
                 payload["stats"] = rec.stats()
                 payload["exemplars"] = rec.exemplars()
+            body = (json.dumps(payload, default=str) + "\n").encode()
+            self._reply(200, body, "application/json")
+        elif path == "/cost":
+            # the AOT device cost ledger (obs/costmodel.py): per-
+            # entrypoint FLOPs / bytes / HBM + the detected platform
+            # peak, as installed by bench / cli cost / the train loop
+            from .costmodel import get_cost_ledger
+
+            ledger = get_cost_ledger()
+            payload = {"enabled": ledger is not None}
+            if ledger is not None:
+                payload["ledger"] = ledger.to_dict()
             body = (json.dumps(payload, default=str) + "\n").encode()
             self._reply(200, body, "application/json")
         else:

@@ -345,6 +345,21 @@ def summarize_run(run_dir: str) -> dict:
                                    "burn_fast", "burn_slow")}
             for r in burns]
 
+    # ---- the AOT device cost ledger (cost_ledger events streamed by
+    # obs/costmodel.py at train start / bench warmup): the per-entrypoint
+    # FLOPs / bytes / HBM bill the attribution roofline divides by
+    cost_events = [r for r in metrics if r.get("kind") == "cost_ledger"]
+    if cost_events:
+        summary["cost_ledger"] = {
+            "version": cost_events[-1].get("version"),
+            "platform": cost_events[-1].get("platform"),
+            "device_kind": cost_events[-1].get("device_kind"),
+            "entries": [{k: v for k, v in r.items()
+                         if k not in ("kind", "time", "version",
+                                      "platform", "device_kind")}
+                        for r in cost_events],
+        }
+
     # ---- step-time attribution (obs/attribution.py): the per-host
     # wall-clock decomposition, joined across elastic hosts when present
     att = attribute_run(run_dir)
@@ -410,6 +425,33 @@ def format_report(summary: dict) -> str:
                  str(e.get("bucket") if e.get("bucket") is not None
                      else ""),
                  str(e.get("hops", 0))] for e in exemplars]
+        widths = [max(len(c), *(len(r[i]) for r in rows))
+                  for i, c in enumerate(cols)]
+        lines.append("  " + "  ".join(c.ljust(w)
+                                      for c, w in zip(cols, widths)))
+        for r in rows:
+            lines.append("  " + "  ".join(v.ljust(w)
+                                          for v, w in zip(r, widths)))
+    cost = summary.get("cost_ledger")
+    if cost:
+        lines.append("")
+        lines.append(f"device cost ledger (v{cost.get('version')}, "
+                     f"{cost.get('platform')}):")
+        cols = ["entrypoint", "GFLOPs", "MB moved", "AI", "HBM MB", "src"]
+        rows = []
+        for e in cost["entries"]:
+            fn, bucket = e.get("fn", "?"), e.get("bucket")
+            ai = e.get("arithmetic_intensity")
+            rows.append([
+                fn if bucket is None else f"{fn}/b{bucket}",
+                f"{(e.get('flops') or 0) / 1e9:,.1f}",
+                f"{(e.get('bytes_accessed') or 0) / 2**20:,.1f}"
+                if e.get("bytes_accessed") else "-",
+                f"{ai:.1f}" if ai else "-",
+                f"{(e.get('hbm_peak_bytes') or 0) / 2**20:,.1f}"
+                if e.get("hbm_peak_bytes") is not None else "-",
+                str(e.get("source", "")),
+            ])
         widths = [max(len(c), *(len(r[i]) for r in rows))
                   for i, c in enumerate(cols)]
         lines.append("  " + "  ".join(c.ljust(w)

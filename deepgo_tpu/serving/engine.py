@@ -437,7 +437,11 @@ class InferenceEngine:
             write_metrics = (
                 self._metrics is not None
                 and self._dispatches % self.config.metrics_interval == 0)
-        self._obs_dispatch.observe(t_done - t_fwd, engine=self.name)
+        # bucket label: the roofline join (obs/costmodel.py) divides the
+        # rung's AOT FLOPs by this series' mean to get achieved FLOP/s —
+        # one extra label on an existing observe, no new hot-path work
+        self._obs_dispatch.observe(t_done - t_fwd, engine=self.name,
+                                   bucket=bucket)
         for r in live:
             self._obs_request.observe(t_done - r.t_submit, engine=self.name)
         self._obs_dispatches.inc(engine=self.name)

@@ -1,0 +1,486 @@
+"""Device cost-model ledger (obs/costmodel.py) — the ISSUE-12 acceptance.
+
+The load-bearing claims, each pinned here:
+
+  * the analytic FLOPs estimator agrees with XLA's ``cost_analysis()``
+    to a tolerance band (the cross-check that caught the old dense
+    formula's ~10% border-tap overcount);
+  * per-rung ledger monotonicity: FLOPs and bytes never decrease going
+    up the bucket ladder;
+  * degraded mode: a backend with no cost model (or a failing lower)
+    yields an ``estimated`` row with the analytic count, never a crash;
+  * the exporter's ``/cost`` route round-trips the installed ledger;
+  * the MFU-floor gate matrix (pass / fail / skip) and its fold into
+    ``bench --gate``'s verdict;
+  * the attribution join: a real dryrun train with the ledger armed
+    reports MFU next to its wall-clock buckets — offline, from the
+    snapshot alone;
+  * the engine's per-bucket dispatch histogram joins into per-rung
+    achieved FLOP/s.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+from deepgo_tpu.models import policy_cnn
+from deepgo_tpu.obs import costmodel
+from deepgo_tpu.obs.registry import MetricsRegistry
+
+SMALL = policy_cnn.CONFIGS["small"]
+
+
+class ListSink:
+    def __init__(self):
+        self.events = []
+
+    def write(self, kind, **fields):
+        self.events.append({"kind": kind, **fields})
+
+
+@pytest.fixture(scope="module")
+def ladder_ledger():
+    """One AOT sweep of the small config's first three rungs, shared by
+    every test that only reads it (each rung is a real XLA compile)."""
+    reg = MetricsRegistry()
+    sink = ListSink()
+    ledger = costmodel.CostLedger(registry=reg, sink=sink)
+    costmodel.ladder_entries(ledger, SMALL, buckets=(1, 8, 32))
+    return ledger, reg, sink
+
+
+# ---- the analytic estimator vs the compiler ----
+
+
+def test_analytic_flops_matches_xla_cost_analysis(ladder_ledger):
+    ledger, _, _ = ladder_ledger
+    for bucket in (1, 8, 32):
+        entry = ledger.get("policy_forward", bucket)
+        assert entry is not None and entry.source == "xla"
+        analytic = costmodel.analytic_flops(SMALL, bucket)
+        # the band: expansion/bias/softmax ops ride in the XLA count but
+        # not the conv-only estimate; border-tap accounting must agree
+        assert abs(analytic - entry.flops) / entry.flops < 0.05, (
+            bucket, analytic, entry.flops)
+
+
+def test_dense_formula_would_fail_the_band():
+    # the regression the cross-check exists to catch: the old dense
+    # k^2*cin*cout*361 count overstates the 19x19 stack by ~10%
+    dense = sum(2.0 * k * k * cin * cout * 361
+                for k, cin, cout in SMALL.layer_shapes())
+    exact = costmodel.analytic_flops(SMALL)
+    assert (dense - exact) / exact > 0.05
+
+
+def test_analytic_train_flops_is_3x_forward():
+    assert costmodel.analytic_train_flops(SMALL, 4) == \
+        3.0 * costmodel.analytic_flops(SMALL, 4)
+
+
+# ---- ladder monotonicity + the published surfaces ----
+
+
+def test_ladder_flops_and_bytes_monotonic_up_the_rungs(ladder_ledger):
+    ledger, _, _ = ladder_ledger
+    entries = [ledger.get("policy_forward", b) for b in (1, 8, 32)]
+    flops = [e.flops for e in entries]
+    bytes_ = [e.bytes_accessed for e in entries]
+    hbm = [e.hbm_peak_bytes for e in entries]
+    assert flops == sorted(flops) and flops[0] < flops[-1]
+    assert bytes_ == sorted(bytes_) and bytes_[0] < bytes_[-1]
+    assert hbm == sorted(hbm)
+
+
+def test_ledger_publishes_gauges_and_versioned_events(ladder_ledger):
+    ledger, reg, sink = ladder_ledger
+    entry = ledger.get("policy_forward", 8)
+    assert reg.gauge("deepgo_cost_flops").value(
+        fn="policy_forward", bucket=8) == entry.flops
+    assert reg.gauge("deepgo_cost_hbm_peak_bytes").value(
+        fn="policy_forward", bucket=8) == entry.hbm_peak_bytes
+    assert reg.gauge("deepgo_cost_compile_seconds").value(
+        fn="policy_forward", bucket=8) > 0
+    events = [e for e in sink.events if e["kind"] == "cost_ledger"]
+    assert len(events) == 3
+    for e in events:
+        assert e["version"] == costmodel.VERSION
+        assert e["fn"] == "policy_forward" and e["source"] == "xla"
+        assert e["flops"] > 0 and e["platform"] == ledger.peak.platform
+
+
+def test_hbm_bill_reflects_argument_output_temp(ladder_ledger):
+    ledger, _, _ = ladder_ledger
+    e = ledger.get("policy_forward", 8)
+    assert e.hbm_argument_bytes > 0 and e.hbm_output_bytes > 0
+    assert e.hbm_peak_bytes >= e.hbm_argument_bytes + e.hbm_output_bytes
+
+
+# ---- degraded mode ----
+
+
+class _LowerRaises:
+    def lower(self, *a, **k):
+        raise RuntimeError("backend has no AOT path")
+
+
+class _NoCostModel:
+    """lower/compile succeed; cost_analysis returns nothing (the shape
+    some backends actually have)."""
+
+    class _Compiled:
+        def cost_analysis(self):
+            return []
+
+        def memory_analysis(self):
+            return None
+
+    class _Lowered:
+        def compile(self):
+            return _NoCostModel._Compiled()
+
+    def lower(self, *a, **k):
+        return self._Lowered()
+
+
+@pytest.mark.parametrize("broken", [_LowerRaises(), _NoCostModel()],
+                         ids=["lower-raises", "empty-cost-model"])
+def test_degraded_mode_marks_estimated_and_never_crashes(broken):
+    ledger = costmodel.CostLedger(registry=MetricsRegistry())
+    entry = ledger.measure("broken", broken, (), bucket=4,
+                           analytic=costmodel.analytic_flops(SMALL, 4))
+    assert entry.source == "estimated"
+    assert entry.flops == costmodel.analytic_flops(SMALL, 4)
+    assert entry.bytes_accessed is None and entry.hbm_peak_bytes is None
+    # degraded rows still join: no bytes -> no AI -> no bound, mfu from
+    # the analytic count when a timing exists
+    block = ledger.roofline({("broken", 4): 0.5})
+    row = block["entries"]["broken/b4"]
+    assert row["bound"] is None
+    assert row["achieved_flops_per_s"] == pytest.approx(entry.flops / 0.5)
+
+
+def test_degraded_mode_without_estimator_is_a_zero_row():
+    ledger = costmodel.CostLedger(registry=MetricsRegistry())
+    entry = ledger.measure("broken", _LowerRaises(), ())
+    assert entry.source == "estimated" and entry.flops == 0.0
+
+
+# ---- platform peak detection ----
+
+
+def test_detect_peak_cpu_is_estimated_with_capacity():
+    peak = costmodel.detect_peak()
+    assert peak.platform == "cpu" and peak.source == "estimated"
+    assert peak.flops_per_s > 0 and peak.ridge_flops_per_byte > 0
+
+
+def test_detect_peak_tpu_table_and_unknown():
+    class Dev:
+        def __init__(self, platform, kind):
+            self.platform, self.device_kind = platform, kind
+
+    v5e = costmodel.detect_peak(Dev("tpu", "TPU v5 lite"))
+    assert v5e.source == "table" and v5e.flops_per_s == 197e12
+    assert v5e.hbm_capacity_bytes == 16 * 2**30
+    mystery = costmodel.detect_peak(Dev("tpu", "TPU v99"))
+    assert mystery.source == "unknown" and mystery.flops_per_s is None
+    # unknown peaks must yield honest Nones, not crashes
+    e = costmodel.CostEntry("f", 1, 1e9, 1e6, None, None, None, None,
+                            0.1, "xla", "tpu")
+    row = costmodel.roofline_entry(e, mystery, seconds_per_call=0.01)
+    assert row["mfu"] is None and row["bound"] is None
+    assert row["achieved_flops_per_s"] == pytest.approx(1e11)
+
+
+# ---- /cost route ----
+
+
+def test_cost_route_roundtrip(ladder_ledger):
+    from deepgo_tpu.obs.exporter import ObsExporter
+
+    ledger, _, _ = ladder_ledger
+    exporter = ObsExporter(port=0)
+    try:
+        costmodel.set_cost_ledger(None)
+        with urllib.request.urlopen(exporter.url + "/cost", timeout=5) as r:
+            empty = json.loads(r.read())
+        assert empty == {"enabled": False}
+        costmodel.set_cost_ledger(ledger)
+        with urllib.request.urlopen(exporter.url + "/cost", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert payload["enabled"] is True
+        led = payload["ledger"]
+        assert led["version"] == costmodel.VERSION
+        assert len(led["entries"]) == 3
+        assert led["peak"]["flops_per_s"] > 0
+        keys = {(e["fn"], e["bucket"]) for e in led["entries"]}
+        assert keys == {("policy_forward", b) for b in (1, 8, 32)}
+    finally:
+        costmodel.set_cost_ledger(None)
+        exporter.close()
+
+
+# ---- the MFU-floor gate ----
+
+
+def _block(**mfus):
+    return {"entries": {k: {"mfu": v} for k, v in mfus.items()}}
+
+
+class TestMfuFloor:
+    def test_within_floor_passes(self):
+        out = costmodel.evaluate_mfu_floor(
+            _block(a=0.48, b=0.30), _block(a=0.50, b=0.29))
+        assert out["verdict"] == "pass" and out["checked"] == 2
+
+    def test_drop_past_floor_fails_with_the_entry_named(self):
+        out = costmodel.evaluate_mfu_floor(
+            _block(a=0.50, b=0.20), _block(a=0.50, b=0.30))
+        assert out["verdict"] == "fail"
+        assert out["failures"][0]["entry"] == "b"
+        assert "b" in out["reason"]
+
+    def test_floor_is_configurable(self):
+        fresh, base = _block(a=0.45), _block(a=0.50)
+        assert costmodel.evaluate_mfu_floor(
+            fresh, base, floor=0.05)["verdict"] == "fail"
+        assert costmodel.evaluate_mfu_floor(
+            fresh, base, floor=0.20)["verdict"] == "pass"
+
+    def test_missing_roofline_skips(self):
+        assert costmodel.evaluate_mfu_floor(
+            None, _block(a=0.5))["verdict"] == "skip"
+        assert costmodel.evaluate_mfu_floor(
+            _block(a=0.5), None)["verdict"] == "skip"
+
+    def test_no_comparable_mfu_skips(self):
+        # AOT-only entries (mfu None) and disjoint keys never fail
+        assert costmodel.evaluate_mfu_floor(
+            _block(a=None), _block(a=0.5))["verdict"] == "skip"
+        assert costmodel.evaluate_mfu_floor(
+            _block(a=0.5), _block(b=0.5))["verdict"] == "skip"
+
+    def test_improvement_never_fails(self):
+        out = costmodel.evaluate_mfu_floor(
+            _block(a=0.60), _block(a=0.30))
+        assert out["verdict"] == "pass"
+
+    def test_bench_gate_folds_mfu_floor_into_the_verdict(self):
+        # the bench fold: throughput passed, MFU dropped -> gate fails
+        import bench
+
+        class Args:
+            gate = 0.10
+
+        result = {
+            "metric": "m", "value": 100.0, "device": "d",
+            "roofline": _block(**{"policy_forward/b8": 0.2}),
+        }
+        entry = {"metric": "m", "value": 100.0, "device": "d",
+                 "roofline": _block(**{"policy_forward/b8": 0.5})}
+        real = bench.LAST_GOOD_PATH
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"m": entry}, f)
+        bench.LAST_GOOD_PATH = f.name
+        try:
+            bench._apply_gate(result, Args())
+        finally:
+            bench.LAST_GOOD_PATH = real
+            os.unlink(f.name)
+        gate = result["gate"]
+        assert gate["mfu_floor"]["verdict"] == "fail"
+        assert gate["verdict"] == "fail"
+        assert "MFU floor" in gate["reason"]
+
+    def test_bench_gate_mfu_pass_keeps_throughput_verdict(self):
+        import tempfile
+
+        import bench
+
+        class Args:
+            gate = 0.10
+
+        result = {"metric": "m", "value": 100.0, "device": "d",
+                  "roofline": _block(**{"policy_forward/b8": 0.5})}
+        entry = {"metric": "m", "value": 100.0, "device": "d",
+                 "roofline": _block(**{"policy_forward/b8": 0.5})}
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"m": entry}, f)
+        real = bench.LAST_GOOD_PATH
+        bench.LAST_GOOD_PATH = f.name
+        try:
+            bench._apply_gate(result, Args())
+        finally:
+            bench.LAST_GOOD_PATH = real
+            os.unlink(f.name)
+        assert result["gate"]["verdict"] == "pass"
+        assert result["gate"]["mfu_floor"]["verdict"] == "pass"
+
+
+# ---- the serving join: per-bucket dispatch histogram -> per-rung MFU ----
+
+
+def test_engine_dispatch_join_produces_per_rung_mfu():
+    import jax
+
+    from deepgo_tpu.models.serving import make_log_prob_fn
+    from deepgo_tpu.obs import get_registry
+    from deepgo_tpu.serving import EngineConfig, InferenceEngine
+
+    params = policy_cnn.init(jax.random.key(0), SMALL)
+    engine = InferenceEngine(make_log_prob_fn(SMALL), params,
+                             EngineConfig(buckets=(1, 8), max_wait_ms=0.5),
+                             name="costjoin")
+    try:
+        engine.warmup()
+        rng = np.random.default_rng(0)
+        packed = rng.integers(0, 3, size=(9, 19, 19), dtype=np.uint8)
+        for _ in range(3):
+            engine.submit(packed, 1, 1).result(timeout=30)
+    finally:
+        engine.close()
+    snap = get_registry().snapshot()["metrics"]
+    secs = costmodel.dispatch_seconds_by_bucket(snap)
+    assert 1 in secs and secs[1] > 0
+    ledger = costmodel.CostLedger(registry=MetricsRegistry())
+    costmodel.ladder_entries(ledger, SMALL, buckets=(1,))
+    block = ledger.roofline({("policy_forward", 1): secs[1]})
+    row = block["entries"]["policy_forward/b1"]
+    assert row["achieved_flops_per_s"] > 0
+    assert row["mfu"] is not None and 0 < row["mfu"] < 1.5
+    assert row["bound"] in ("compute", "memory")
+
+
+# ---- the train entrypoint + memoization ----
+
+
+def test_train_entry_prices_fwd_plus_bwd_and_memoizes():
+    reg = MetricsRegistry()
+    ledger = costmodel.CostLedger(registry=reg)
+    entry = costmodel.train_entry(ledger, SMALL, 8)
+    fwd = ledger.measure("fwd", _LowerRaises(), (),
+                         analytic=costmodel.analytic_flops(SMALL, 8))
+    assert entry.source == "xla"
+    # backward ~ 1.5-2x forward (XLA skips the input-grad conv of the
+    # first layer): the step must cost 2-3.5x the forward
+    assert 2.0 < entry.flops / fwd.flops < 3.5
+    # second ledger, same program: memoized (no recompile -> same object)
+    ledger2 = costmodel.CostLedger(registry=MetricsRegistry())
+    again = costmodel.train_entry(ledger2, SMALL, 8)
+    assert again is entry
+    assert ledger2.get("train_step", 8) is entry
+
+
+# ---- the attribution join on a real dryrun train ----
+
+
+@pytest.fixture(scope="module")
+def trained_run(tmp_path_factory):
+    from deepgo_tpu.data.transcribe import transcribe_split
+    from deepgo_tpu.experiments import Experiment, ExperimentConfig
+
+    data_root = tmp_path_factory.mktemp("processed")
+    for split in ("validation", "test"):
+        transcribe_split(os.path.join(REPO_ROOT, "data/sgf", split),
+                         str(data_root / split), workers=1, verbose=False)
+    cfg = ExperimentConfig(
+        name="cost-dryrun", num_layers=2, channels=8, batch_size=8,
+        validation_size=16, validation_interval=10, print_interval=5,
+        data_root=str(data_root), train_split="validation",
+        validation_split="test", loader_threads=0, data_parallel=1,
+        run_dir=str(tmp_path_factory.mktemp("runs")))
+    exp = Experiment(cfg)
+    exp.run(10)
+    return exp.run_path
+
+
+def test_dryrun_train_attribution_carries_mfu(trained_run):
+    from deepgo_tpu.obs.attribution import attribute_run
+
+    att = attribute_run(trained_run)
+    roof = att["hosts"]["0"].get("roofline")
+    assert roof is not None, att["hosts"]["0"]
+    assert roof["flops_per_step"] > 0
+    assert roof["achieved_flops_per_s"] > 0
+    assert roof["mfu"] is not None and roof["mfu"] > 0
+    assert roof.get("bound") in ("compute", "memory")
+
+
+def test_dryrun_train_streams_cost_ledger_event(trained_run):
+    from deepgo_tpu.obs.report import read_events
+
+    events = [r for r in read_events(os.path.join(trained_run,
+                                                  "metrics.jsonl"))
+              if r.get("kind") == "cost_ledger"]
+    assert events, "train start must stream its step's bill"
+    assert events[0]["fn"] == "train_step"
+    assert events[0]["version"] == costmodel.VERSION
+    assert events[0]["bucket"] == 8  # the config's batch size
+
+
+def test_cli_obs_renders_cost_ledger_and_mfu(trained_run, capsys):
+    from deepgo_tpu.cli import main
+
+    main(["obs", trained_run])
+    out = capsys.readouterr().out
+    assert "device cost ledger" in out
+    assert "roofline: MFU" in out
+    main(["obs", trained_run, "--json"])
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["cost_ledger"]["entries"][0]["fn"] == "train_step"
+    assert summary["attribution"]["hosts"]["0"]["roofline"]["mfu"] > 0
+
+
+def test_cost_ledger_off_switch(tmp_path):
+    # cost_ledger=False: no AOT pass, no gauges, attribution has no
+    # roofline — the join degrades, never breaks
+    from deepgo_tpu.obs.attribution import attribute_snapshot
+
+    reg = MetricsRegistry()
+    reg.counter("deepgo_train_wall_seconds_total").inc(10.0)
+    reg.counter("deepgo_train_steps_total").inc(5)
+    att = attribute_snapshot(reg.snapshot()["metrics"])
+    assert att is not None and "roofline" not in att
+
+
+# ---- cli cost ----
+
+
+def test_cli_cost_json(capsys):
+    from deepgo_tpu.cli import main
+
+    try:
+        main(["cost", "--model", "small", "--buckets", "1,8",
+              "--train-batch", "0", "--sym-bucket", "0", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert set(out["entries"]) == {"policy_forward/b1",
+                                      "policy_forward/b8"}
+        for row in out["entries"].values():
+            assert row["flops"] > 0 and row["mfu"] is None
+        # the command installs the ledger for a live /cost route
+        assert costmodel.get_cost_ledger() is not None
+    finally:
+        costmodel.set_cost_ledger(None)
+
+
+def test_cli_cost_table_renders(capsys):
+    from deepgo_tpu.cli import main
+
+    try:
+        main(["cost", "--model", "small", "--buckets", "1",
+              "--train-batch", "8", "--sym-bucket", "0"])
+        out = capsys.readouterr().out
+        assert "device cost ledger v1" in out
+        assert "policy_forward/b1" in out and "train_step/b8" in out
+        assert "eval_step/b8" in out
+    finally:
+        costmodel.set_cost_ledger(None)
