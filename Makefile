@@ -3,7 +3,7 @@
 .PHONY: native data test test-full lint verify verify-faults verify-serving \
     verify-resilience verify-fleet verify-distributed verify-obs \
     verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
-    verify-cost bench bench-gate smoke clean
+    verify-cost verify-quant bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -63,7 +63,10 @@ verify-xlacheck:  # XLA-contract sanitizer: recompile sentinel (live storm), tra
 verify-cost:  # device cost ledger: analytic-vs-XLA cross-check, ladder monotonicity, degraded mode, /cost route, MFU-floor gate, attribution MFU join
 	JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost  # the full failure-model suite
+verify-quant:  # int8 + fused-sym serving variants: po2 bitwise identity, per-rung tolerance floors, mixed-variant fleet zero-recompile, hot-swap old-or-new proof, refusal path
+	JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -q
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -632,6 +632,21 @@ def _apply_gate(result: dict, args) -> None:
                 reason=f"{ssc} steady-state compile(s) post-warmup — the "
                        "zero-recompile contract is broken "
                        f"(was: {result['gate'].get('reason')})")
+    # the variant tolerance verdict folds in: a quantized variant that
+    # failed its floors (or refused to serve) fails the gate even when
+    # the f32 throughput passed — speed never silently costs correctness
+    var = result.get("variant")
+    if var is not None:
+        tol = (var.get("tolerance") or {}).get("verdict")
+        result["gate"]["variant_tolerance"] = tol
+        if (tol != "pass" or not var.get("served")) \
+                and result["gate"].get("verdict") != "fail":
+            result["gate"].update(
+                verdict="fail",
+                reason=f"variant {var.get('name')} tolerance verdict "
+                       f"{tol!r} (served={var.get('served')}) — the "
+                       "quantized program may not serve "
+                       f"(was: {result['gate'].get('reason')})")
     # the MFU floor folds in next to the throughput verdict: a run that
     # "won" its boards/sec gate by spending hardware efficiency (bigger
     # pads, silent f32 fallback, a dropped fusion) fails here. Skipped
@@ -1036,8 +1051,135 @@ def _tracing_ab(forward, params, ecfg, tracing_mod,
     }
 
 
+def _grid_decisive_params(cfg, params, seed: int = 0, sharp: float = 4.0):
+    """Bench weights for the --variant run: the random-init net snapped
+    onto the po2-int8 grid, final per-position bias sharpened.
+
+    A random-init net's argmax is near-uniform, so int8 tolerance on it
+    legitimately REFUSES (quant noise flips ties between ~equal moves —
+    the honest verdict, and exactly what production gating should do to
+    an undecided net). The bench's job here is throughput + the gate
+    plumbing, and throughput is weight-value-independent, so it serves a
+    net the scheme represents exactly: grid weights quantize losslessly
+    (models/quant.py — the po2 bitwise identity) and the sharp bias
+    gives argmax real margins. Production tolerance runs the trained
+    champion over real positions (docs/serving.md)."""
+    import jax.numpy as jnp
+
+    from deepgo_tpu.models import quant
+
+    snapped = quant.dequantize_params(quant.quantize_params(params))
+    rng = np.random.default_rng(seed)
+    b = np.asarray(snapped["layers"][-1]["b"])
+    snapped["layers"][-1]["b"] = jnp.asarray(
+        rng.normal(0.0, sharp, size=b.shape).astype(np.float32))
+    return snapped
+
+
+def _variant_ab(variant: str, vspec, forward, params, cfg, ecfg, buckets,
+                cost_ledger, submitters: int = 4,
+                per_thread: int = 48) -> dict:
+    """The quantized-serving A/B: tolerance gate, then identical
+    concurrent-submitter bursts through an f32 engine and a variant
+    engine over the SAME snapped weights (best-of-2 per arm,
+    interleaved), plus the per-rung MFU join of each arm against its own
+    AOT rows. Returns the `variant` block for the BENCH json."""
+    import threading
+
+    from deepgo_tpu.obs import costmodel, get_registry
+    from deepgo_tpu.serving import InferenceEngine, VariantToleranceError
+    from deepgo_tpu.serving.variants import variant_fn_name, verify_variant
+
+    block = {"name": variant}
+    try:
+        block["tolerance"] = verify_variant(cfg, params, variant,
+                                            buckets=buckets)
+    except VariantToleranceError as e:
+        # the refusal IS the contract: no engine is built, no throughput
+        # is quoted for a variant that failed its tolerance floors
+        block["tolerance"] = e.report
+        block["served"] = False
+        return block
+    block["served"] = True
+    prepared = vspec.prepare(params)
+    rng = np.random.default_rng(11)
+    packed, player, rank = _rand_batch(rng, (submitters,))
+    boards = submitters * per_thread
+
+    def burst(fwd, p, tag: str) -> float:
+        eng = InferenceEngine(fwd, p, ecfg, name=tag)
+        eng.warmup()
+
+        def submitter(i: int) -> None:
+            for _ in range(per_thread):
+                eng.submit(packed[i], int(player[i]), int(rank[i])).result()
+
+        threads = [threading.Thread(target=submitter, args=(i,),
+                                    name=f"bench-vab-{tag}-{i}")
+                   for i in range(submitters)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.time() - t0
+        eng.close()
+        return boards / dt
+
+    rates = {"f32": 0.0, variant: 0.0}
+    for i in range(2):
+        rates["f32"] = max(rates["f32"], burst(forward, params, "vab-f32"))
+        rates[variant] = max(rates[variant],
+                             burst(vspec.forward, prepared,
+                                   f"vab-{variant}"))
+    block["f32_boards_per_sec"] = round(rates["f32"], 1)
+    block["boards_per_sec"] = round(rates[variant], 1)
+    block["throughput_ratio_vs_f32"] = round(rates[variant] / rates["f32"],
+                                             3)
+    # per-rung MFU: each arm's dispatch histogram joined against its own
+    # AOT rows (engine-filtered — the two arms run different programs)
+    snap = get_registry().snapshot()["metrics"]
+    vfn = variant_fn_name(variant)
+    f32_secs = costmodel.dispatch_seconds_by_bucket(snap, engine="vab-f32")
+    var_secs = costmodel.dispatch_seconds_by_bucket(
+        snap, engine=f"vab-{variant}")
+    roof = cost_ledger.roofline(
+        {("policy_forward", b): s for b, s in f32_secs.items()}
+        | {(vfn, b): s for b, s in var_secs.items()})
+    block["mfu_per_rung"] = {
+        key: {"mfu": e["mfu"], "seconds_per_call": e.get("seconds_per_call")}
+        for key, e in roof["entries"].items()
+        if key.startswith((vfn, "policy_forward")) and e["mfu"] is not None}
+    # the fused-ensemble economics: per-request dispatch cost at each
+    # shared rung vs the plain forward (the "<= 2x of a single forward"
+    # acceptance measure — FLOPs are honestly ~8x, amortization is what
+    # fusion buys; see costmodel.fused_sym_entry)
+    if "sym" in variant:
+        block["cost_ratio_vs_plain_per_rung"] = {
+            str(b): round(var_secs[b] / f32_secs[b], 3)
+            for b in sorted(set(var_secs) & set(f32_secs))
+            if f32_secs[b] > 0}
+        # the accelerator economics the measured CPU ratio cannot show:
+        # on a memory-bound chip the rung's cost is bytes/bandwidth, and
+        # the fused program re-uses ONE weight fetch for all 8 views —
+        # the AOT bytes ratio is the cost ratio a TPU capture will see
+        # (int8+sym on the large config prices ~2.0x a single f32
+        # forward at rung 1, vs ~7x for the unfused path)
+        ratios = {}
+        for e in cost_ledger.entries:
+            if e.fn != vfn or not e.bytes_accessed:
+                continue
+            plain = cost_ledger.get("policy_forward", e.bucket)
+            if plain is not None and plain.bytes_accessed:
+                ratios[str(e.bucket)] = round(
+                    e.bytes_accessed / plain.bytes_accessed, 3)
+        block["ledger_bytes_ratio_vs_plain_per_rung"] = ratios
+    return block
+
+
 def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
-                   exporter=None, fleet: int | None = None) -> dict:
+                   exporter=None, fleet: int | None = None,
+                   variant: str | None = None) -> dict:
     """Micro-batching engine throughput under concurrent submitters.
 
     Unlike --mode inference (one giant pre-staged batch through a scan —
@@ -1064,7 +1206,16 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     the /healthz status transitions around the replica kill. Chaos fleet
     replicas run with ``max_restarts=0`` so an injected dispatcher kill
     exhausts the replica's own supervisor and exercises the FLEET
-    failure domain: failover with exclusion + background respawn."""
+    failure domain: failover with exclusion + background respawn.
+
+    ``variant`` (--variant int8|sym|int8+sym) adds the quantized-serving
+    A/B: the run serves grid-snapped decisive weights (see
+    ``_grid_decisive_params``), the variant is tolerance-gated (a
+    failing variant REFUSES and the block says so), and the JSON gains a
+    ``variant`` block — throughput ratio vs f32 over identical bursts,
+    the tolerance verdict, per-rung MFU for both programs, and (for sym
+    variants) the per-rung fused-ensemble cost ratio vs the plain
+    forward. The verdict folds into ``--gate``."""
     import jax
 
     from deepgo_tpu.models import policy_cnn
@@ -1085,6 +1236,12 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         submitters = max(submitters, 6)
     cfg = policy_cnn.CONFIGS[name]
     params = policy_cnn.init(jax.random.key(0), cfg)
+    vspec = None
+    if variant:
+        from deepgo_tpu.serving.variants import variant_spec
+
+        params = _grid_decisive_params(cfg, params)
+        vspec = variant_spec(cfg, variant)
     forward = make_log_prob_fn(cfg)
     ecfg = EngineConfig(buckets=buckets, max_wait_ms=2.0)
     # the AOT device cost ledger (obs/costmodel.py): price every ladder
@@ -1098,6 +1255,11 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     cost_ledger = costmodel.CostLedger()
     costmodel.ladder_entries(cost_ledger, cfg, buckets=buckets,
                              forward=forward)
+    if vspec is not None:
+        # the variant's AOT rows ride next to the f32 ladder's, so the
+        # gate's MFU floor covers the quantized program too
+        costmodel.variant_entries(cost_ledger, cfg, variant,
+                                  buckets=buckets, forward=vspec.forward)
     costmodel.set_cost_ledger(cost_ledger)
     # request-scoped tracing rides the whole run (obs/tracing.py): every
     # submit gets a timeline, tail exemplars stream to trace.jsonl next
@@ -1420,14 +1582,24 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         if xlacheck_report is not None:
             result["xlacheck"] = xlacheck_report
     result["tracing"] = tracing_block
+    if vspec is not None:
+        result["variant"] = _variant_ab(variant, vspec, forward, params,
+                                        cfg, ecfg, buckets, cost_ledger)
+        if not result["variant"]["served"]:
+            errors.append(f"variant {variant} refused to serve "
+                          "(tolerance floors failed)")
     # per-rung roofline: the AOT ladder ledger joined with the measured
     # per-bucket dispatch means (deepgo_serving_dispatch_seconds{bucket})
     # — achieved FLOP/s, MFU, and the bound class for every rung the run
-    # actually hit; rungs it never dispatched stay AOT-only (mfu null)
+    # actually hit; rungs it never dispatched stay AOT-only (mfu null).
+    # On a --variant run the f32 join restricts to the main engine's own
+    # series — the variant arm runs a DIFFERENT program whose dispatch
+    # times must not blend into the f32 rungs.
     from deepgo_tpu.obs import get_registry
 
     rung_secs = costmodel.dispatch_seconds_by_bucket(
-        get_registry().snapshot()["metrics"])
+        get_registry().snapshot()["metrics"],
+        engine="bench" if vspec is not None else None)
     result["roofline"] = cost_ledger.roofline(
         {("policy_forward", b): s for b, s in rung_secs.items()})
     if errors:
@@ -1463,6 +1635,15 @@ def main() -> None:
                          "failover + respawn counters, and "
                          "reload-without-drop (with --faults: replica "
                          "kill chaos + /healthz flip tracking)")
+    ap.add_argument("--variant", default=None, metavar="NAME",
+                    help="(--mode serving) the quantized-serving A/B: "
+                         "run the standard workload, then tolerance-gate "
+                         "and burst-compare the named serving variant "
+                         "(int8 | sym | int8+sym — serving/variants.py) "
+                         "against f32 over identical weights; the JSON "
+                         "gains a `variant` block (throughput ratio, "
+                         "tolerance verdict, per-rung MFU) folded into "
+                         "the --gate verdict")
     ap.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                     help="serve live /metrics + /healthz while the bench "
                          "runs (0 = ephemeral port) and attach the final "
@@ -1485,6 +1666,13 @@ def main() -> None:
         ap.error("--fleet only applies to --mode serving")
     if args.fleet is not None and args.fleet < 2:
         ap.error("--fleet needs N >= 2 (a 1-replica fleet is --faults)")
+    if args.variant is not None:
+        if args.mode != "serving" or args.fleet or args.faults:
+            ap.error("--variant applies to plain --mode serving only "
+                     "(no --fleet / --faults)")
+        if args.variant not in ("int8", "sym", "int8+sym"):
+            ap.error(f"unknown --variant {args.variant!r} "
+                     "(int8 | sym | int8+sym)")
     if args.faults == "__default__":
         args.faults = (DEFAULT_DIST_FAULTS if args.mode == "distributed"
                        else DEFAULT_LOOP_FAULTS if args.mode == "loop"
@@ -1539,7 +1727,8 @@ def main() -> None:
         if args.mode == "serving":
             result = _bench_serving(on_tpu, args.faults,
                                     exporter=obs_exporter,
-                                    fleet=args.fleet)
+                                    fleet=args.fleet,
+                                    variant=args.variant)
         elif args.mode == "loop":
             result = _bench_loop(on_tpu, args.faults)
         else:

@@ -623,7 +623,8 @@ class Value2PlyAgent(ValueSearchAgent):
                             tie_scale=1e-4)
 
 
-def _policy_engine_for(params, cfg, use_engine, fleet: int = 1):
+def _policy_engine_for(params, cfg, use_engine, fleet: int = 1,
+                       variant: str = "f32"):
     """The shared policy engine for this checkpoint, or None. Agents built
     from the same params then coalesce their per-ply forwards into the
     same micro-batched dispatches (serving.shared_policy_engine).
@@ -631,22 +632,34 @@ def _policy_engine_for(params, cfg, use_engine, fleet: int = 1):
     resilience supervisor (serving.SupervisedEngine) so agents ride
     through dispatcher restarts untouched; ``fleet >= 2`` spreads it over
     that many supervised replicas behind the failover router
-    (serving.FleetRouter — docs/serving.md)."""
+    (serving.FleetRouter — docs/serving.md). ``variant`` selects the
+    serving program (f32 | int8 | sym | int8+sym — serving/variants.py;
+    lossy variants tolerance-gate before serving), memoized per
+    (checkpoint, variant) so an int8 agent and an f32 agent of the same
+    champion coexist for a live arena A/B."""
+    if not use_engine and variant != "f32":
+        raise ValueError(
+            f"variant {variant!r} needs the serving engine path — pass "
+            "--engine/--supervised/--fleet (the variant forward lives in "
+            "the shared engine registry, docs/serving.md)")
     if not use_engine:
         return None
     from .serving import shared_policy_engine
 
     return shared_policy_engine(params, cfg,
                                 supervised=use_engine == "supervised",
-                                fleet=fleet)
+                                fleet=fleet, variant=variant)
 
 
 def _make_agent(spec: str, seed: int, temperature: float = 0.0,
-                rank: int = 9, use_engine=False, fleet: int = 1) -> Agent:
+                rank: int = 9, use_engine=False, fleet: int = 1,
+                variant: str = "f32") -> Agent:
     """``use_engine``: False (direct ladder path), True (shared
     micro-batching engine), or "supervised" (shared engine under the
     resilience supervisor). ``fleet >= 2`` upgrades the shared engines to
-    a FleetRouter of that many supervised replicas."""
+    a FleetRouter of that many supervised replicas. ``variant`` routes
+    the POLICY forward through a named serving variant (arena A/B:
+    quantized vs full-precision champions)."""
     if spec == "random":
         return RandomAgent()
     if spec == "heuristic":
@@ -660,7 +673,8 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         return PolicyAgent(params, cfg, name="policy", temperature=temperature,
                            rank=rank,
                            engine=_policy_engine_for(params, cfg, use_engine,
-                                                     fleet=fleet))
+                                                     fleet=fleet,
+                                                     variant=variant))
     if spec.startswith("search:"):
         from .models.serving import load_policy
 
@@ -671,14 +685,16 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         return PolicySearchAgent(params, cfg, rank=rank,
                                  engine=_policy_engine_for(params, cfg,
                                                            use_engine,
-                                                           fleet=fleet))
+                                                           fleet=fleet,
+                                                           variant=variant))
     if spec.startswith("search2:"):
         from .models.serving import load_policy
 
         _, params, cfg = load_policy(spec.split(":", 1)[1])
         return TwoPlyAgent(params, cfg, rank=rank,
                            engine=_policy_engine_for(params, cfg, use_engine,
-                                                     fleet=fleet))
+                                                     fleet=fleet,
+                                                     variant=variant))
     if spec.startswith(("value:", "value2:")):
         from .models.serving import load_policy, load_value
 
@@ -709,7 +725,8 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         return PolicyAgent(params, cfg, name=f"init-{spec.split(':', 1)[1]}",
                            temperature=temperature, rank=rank,
                            engine=_policy_engine_for(params, cfg, use_engine,
-                                                     fleet=fleet))
+                                                     fleet=fleet,
+                                                     variant=variant))
     raise ValueError(
         f"unknown agent spec {spec!r} "
         "(use random | heuristic | oneply | checkpoint:PATH | search:PATH "

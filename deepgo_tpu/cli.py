@@ -226,10 +226,28 @@ def cmd_serve(args) -> None:
         cfg = policy_cnn.CONFIGS[args.model]
         params = policy_cnn.init(jax.random.key(0), cfg)
         source = f"random-init {args.model!r}"
-    fleet = fleet_policy_engine(
-        params, cfg, replicas=args.fleet,
-        config=EngineConfig(max_wait_ms=args.max_wait_ms))
+    variants = tuple(v.strip() for v in args.variant.split(",") if v.strip())
+    # the serve gate: lossy variants tolerance-verify against the f32
+    # forward of this very checkpoint before any replica exists — a
+    # failing variant refuses to serve, typed (docs/serving.md)
+    from .serving import VariantToleranceError
+
+    try:
+        fleet = fleet_policy_engine(
+            params, cfg, replicas=args.fleet,
+            config=EngineConfig(max_wait_ms=args.max_wait_ms),
+            variants=variants)
+    except VariantToleranceError as e:
+        raise SystemExit(
+            f"serve: {e}\n(quantizing an undecided net flips tied "
+            "argmaxes — gate a trained champion, or serve --variant "
+            "f32; docs/serving.md \"Serving variants\")") from e
     warmed = fleet.warmup()
+    assignment = [variants[i % len(variants)] for i in range(args.fleet)]
+    if set(assignment) != {"f32"}:
+        print(f"serve: replica variants {assignment} (hot-reload "
+              "re-prepares each replica's program from the new base "
+              "checkpoint)", flush=True)
     exporter = start_exporter(args.obs_port)
     exporter.add_health("fleet", health_from_engine(fleet))
     print(f"serve: fleet of {args.fleet} replica(s) over {source} "
@@ -548,6 +566,13 @@ def main(argv=None) -> None:
                    help="model config for random init (no --checkpoint)")
     p.add_argument("--max-wait-ms", type=float, default=2.0,
                    help="per-replica dispatcher coalescing window")
+    p.add_argument("--variant", default="f32", metavar="CSV",
+                   help="serving variant(s) assigned round-robin per "
+                        "replica: f32 | int8 | sym | int8+sym "
+                        "(e.g. 'f32,int8' A/Bs the quantized champion "
+                        "against full precision live; lossy variants "
+                        "are tolerance-gated before serving — "
+                        "docs/serving.md)")
     p.add_argument("--obs-port", type=int, default=0, metavar="PORT",
                    help="port for /metrics + /healthz (0 = ephemeral, "
                         "printed at startup)")

@@ -236,6 +236,17 @@ def main(argv=None) -> None:
                          "least-wait placement, failover with exclusion, "
                          "background respawn, tiered QoS "
                          "(docs/serving.md)")
+    ap.add_argument("--variant-a", default="f32", metavar="V",
+                    help="serving variant for agent A's policy forward "
+                         "(f32 | int8 | sym | int8+sym — serving/"
+                         "variants.py). The live quantization A/B: "
+                         "'--a checkpoint:C --variant-a int8 --b "
+                         "checkpoint:C' gates the int8 champion against "
+                         "the f32 one under the pinned protocol; lossy "
+                         "variants tolerance-verify before serving and "
+                         "imply --engine (docs/serving.md)")
+    ap.add_argument("--variant-b", default="f32", metavar="V",
+                    help="serving variant for agent B's policy forward")
     args = ap.parse_args(argv)
 
     if args.standard_gate:
@@ -255,11 +266,20 @@ def main(argv=None) -> None:
 
     honor_platform_env()
     use_engine = ("supervised" if args.supervised
-                  else args.engine or args.fleet > 1)
+                  else args.engine or args.fleet > 1
+                  or args.variant_a != "f32" or args.variant_b != "f32")
     agent_a = _make_agent(args.a, args.seed, args.temperature, args.rank,
-                          use_engine=use_engine, fleet=args.fleet)
+                          use_engine=use_engine, fleet=args.fleet,
+                          variant=args.variant_a)
     agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank,
-                          use_engine=use_engine, fleet=args.fleet)
+                          use_engine=use_engine, fleet=args.fleet,
+                          variant=args.variant_b)
+    # distinct names keep the A/B's win-rate keys readable when both
+    # sides are the same checkpoint under different serving variants
+    if args.variant_a != "f32":
+        agent_a.name = f"{agent_a.name}+{args.variant_a}"
+    if args.variant_b != "f32":
+        agent_b.name = f"{agent_b.name}+{args.variant_b}"
     try:
         games, scores, stats = play_match(
             agent_a, agent_b, n_games=args.games, komi=args.komi,

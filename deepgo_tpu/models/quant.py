@@ -1,0 +1,382 @@
+"""Int8 quantized inference + the fused dihedral symmetry ensemble.
+
+The raw forward has been unchanged f32/bf16 since round 4 (ROADMAP open
+item 1); this module is the quantized serving path that closes it. Two
+ideas, composable:
+
+  * **per-output-channel symmetric int8 weight quantization**
+    (``quantize_params``): each conv kernel ``w[k, k, cin, cout]`` is
+    stored as int8 with one f32 scale per OUTPUT channel —
+    ``w ≈ w_q * scale[cout]`` with ``scale = max|w|/127`` over the
+    channel's taps. Activations stay bf16 and the accumulation runs in
+    f32 (``preferred_element_type``), so the only numerics change vs the
+    f32 forward is the weight rounding itself. The dequant multiply is
+    **folded into the conv epilogue** inside the jitted forward
+    (``y = conv(x, w_q) * scale + b``) — per-output-channel scaling
+    commutes with the channel-wise conv sum, so this is exact, and XLA
+    fuses it with the existing bias-add/ReLU epilogue. The pattern is
+    SNIPPETS.md [2]: int8 weights as first-class pytree leaves the
+    sharding/serving machinery handles like any other params.
+  * **fused 8-fold dihedral ensemble** (``make_fused_sym_policy_fn``):
+    the dihedral average that ``make_sym_policy_fn`` computes, restated
+    as an ENGINE-FACING forward that rides the compile-once bucket
+    ladder: all eight views are stacked on the batch axis inside ONE
+    jitted program — permutation gather, plane expansion, conv stack,
+    inverse gather, and a log-sum-exp average (``log((1/8)Σ p_k)``
+    computed stably in log space, never materializing probabilities).
+    ``quant=True`` runs the stack over int8 weights — the ``int8+sym``
+    serving variant.
+
+The **tolerance harness** (``tolerance_report`` / ``check_tolerance``)
+is the gate that lets a lossy variant near production: per bucket-ladder
+rung it measures top-1 agreement and max-abs log-prob drift against the
+exact reference forward of the SAME program shape (int8 vs f32 plain;
+int8+sym vs f32 fused-sym), publishes ``deepgo_quant_*`` gauges, and
+``check_tolerance`` raises a typed :class:`VariantToleranceError` below
+the floors — serving/variants.py calls it before a variant may serve,
+so a quantization regression refuses loudly instead of silently costing
+dan rank (docs/serving.md "Serving variants").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import NUM_POINTS
+from ..ops import get_expand_fn
+from . import policy_cnn
+
+# symmetric int8: the full signed range minus the asymmetric -128, so
+# the codebook is symmetric around zero and dequant is one multiply
+QUANT_MAX = 127.0
+
+
+class VariantToleranceError(RuntimeError):
+    """A lossy serving variant fell below its tolerance floors vs the
+    exact reference forward. The variant must refuse to serve — speed is
+    never allowed to silently cost correctness. Carries the offending
+    ``report`` (the full per-rung measurement)."""
+
+    def __init__(self, message: str, report: dict):
+        super().__init__(message)
+        self.report = report
+
+
+def quantize_params(params: dict) -> dict:
+    """f32 policy params -> the int8 serving pytree.
+
+    Each layer becomes ``{"w_q": int8 (k,k,cin,cout), "w_scale": f32
+    (cout,), "b": f32 (19,19,cout)}``. Symmetric per-output-channel
+    with POWER-OF-TWO scales: ``w_scale = 2^ceil(log2(max|w| / 127))``
+    over the channel's taps (1.0 for an all-zero channel), ``w_q =
+    round(w / w_scale)``. The po2 constraint costs at most one bit of
+    codebook resolution, and buys an exact identity: multiplying by a
+    power of two is a pure exponent shift, so the epilogue dequant
+    commutes BITWISE through the f32 conv accumulation and the bf16
+    downcast — the int8 forward is numerically equivalent to running
+    the reference forward over the dequantized weights ``w_scale*w_q``
+    (which are themselves bf16-exact: 7-bit integers times a po2).
+    Tolerance therefore measures weight rounding alone, with zero
+    compute-path noise, and weights already on the grid round-trip
+    bit-identically (tests assert ``==``). Biases are kept in f32 —
+    361 values per channel, nothing on the weight-movement bill.
+
+    Pure jnp, so ``jax.eval_shape`` can derive the quantized avals for
+    the AOT cost ledger without touching real weights."""
+    layers = []
+    for layer in params["layers"]:
+        w = layer["w"].astype(jnp.float32)
+        amax = jnp.max(jnp.abs(w), axis=(0, 1, 2))
+        scale = jnp.where(
+            amax > 0,
+            jnp.exp2(jnp.ceil(jnp.log2(amax / QUANT_MAX))), 1.0)
+        w_q = jnp.clip(jnp.round(w / scale), -QUANT_MAX, QUANT_MAX)
+        layers.append({"w_q": w_q.astype(jnp.int8),
+                       "w_scale": scale.astype(jnp.float32),
+                       "b": layer["b"]})
+    return {"layers": layers}
+
+
+def dequantize_params(qparams: dict) -> dict:
+    """The f32 pytree the int8 one rounds to (tests; error bounds)."""
+    return {"layers": [
+        {"w": layer["w_q"].astype(jnp.float32) * layer["w_scale"],
+         "b": layer["b"]}
+        for layer in qparams["layers"]]}
+
+
+def quant_apply(qparams: dict, planes: jax.Array,
+                cfg: policy_cnn.ModelConfig) -> jax.Array:
+    """planes (B, 19, 19, 37) -> logits (B, 361) over int8 weights.
+
+    Mirrors ``policy_cnn.apply`` exactly except for the weight path:
+    int8 kernels upcast to the compute dtype at the conv input (integer
+    values <= 127 are exact in bf16), the conv accumulates in f32
+    (``preferred_element_type`` — the MXU's native low-precision-in,
+    f32-accumulate shape), and the per-output-channel dequant scale is
+    folded into the epilogue before the downcast + bias add. Because
+    the scales are powers of two (see ``quantize_params``), the
+    epilogue multiply is an exact exponent shift: every value here is
+    bit-identical to what the REFERENCE forward computes over the
+    dequantized weights, so quantization error is the ONLY numerics
+    difference vs f32 serving. Row-independent like the f32 forward,
+    so bucket padding stays bit-exact per row."""
+    dtype = jnp.dtype(cfg.compute_dtype)
+    x = planes.astype(dtype)
+    n_layers = len(qparams["layers"])
+
+    for i, layer in enumerate(qparams["layers"]):
+        y = jax.lax.conv_general_dilated(
+            x,
+            layer["w_q"].astype(dtype),
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        # the dequant epilogue: an exact po2 exponent shift per output
+        # channel, fused by XLA with the downcast/bias/ReLU it already
+        # emits here; the downcast + bf16 bias add mirror the reference
+        # layer's epilogue bit for bit
+        y = (y * layer["w_scale"][None, None, None, :]).astype(dtype)
+        y = y + layer["b"].astype(dtype)[None]
+        x = jax.nn.relu(y) if (i < n_layers - 1 or cfg.final_relu) else y
+    return x.reshape(x.shape[0], NUM_POINTS).astype(jnp.float32)
+
+
+def make_quant_log_prob_fn(cfg: policy_cnn.ModelConfig,
+                           expand_backend: str = "xla"):
+    """predict(qparams, packed, player, rank) -> (B, 361) log-probs —
+    the int8 twin of ``serving.make_log_prob_fn``, same engine-facing
+    signature, so it rides the bucket ladder / engine / fleet stack
+    unchanged (the params argument is simply the quantized pytree)."""
+    expand_planes = get_expand_fn(expand_backend)
+
+    @jax.jit
+    def log_probs(qparams, packed, player, rank):
+        planes = expand_planes(packed, player, rank,
+                               dtype=jnp.dtype(cfg.compute_dtype))
+        return jax.nn.log_softmax(quant_apply(qparams, planes, cfg), axis=-1)
+
+    return log_probs
+
+
+def make_fused_sym_policy_fn(cfg: policy_cnn.ModelConfig,
+                             quant: bool = False,
+                             expand_backend: str = "xla",
+                             symmetries: int | None = None):
+    """predict(params, packed, player, rank) -> (B, 361) log-probs
+    averaged over the dihedral group, in ONE jitted program.
+
+    Replaces ``make_sym_policy_fn`` as the serving-side ensemble: the
+    eight views are stacked on the batch axis (gather by the precomputed
+    permutation tables), expanded, pushed through one conv-stack
+    invocation, mapped back with the inverse tables, and averaged as a
+    proper mixture via log-sum-exp — ``log((1/S) Σ_k p_k)`` computed in
+    log space, so no probabilities are materialized and the output is
+    finite wherever any view is. ``quant=True`` runs the stack over int8
+    weights (the ``int8+sym`` variant; params is then the quantized
+    pytree). ``symmetries=1`` degrades to the identity view alone — the
+    plumbing check: its output is bit-identical to the plain forward
+    (tests assert ``==``). ``expand_backend="pallas"`` fuses the view
+    gather INTO the plane expansion via the Pallas kernel in
+    ``ops/pallas_expand.py`` when the backend can compile Mosaic
+    kernels, and falls back to the XLA path otherwise.
+
+    FLOPs are still S x the plain forward (the AOT ledger's
+    ``fused_sym_entry`` says so honestly); what fusion buys is the
+    serving economics: one request occupies ONE bucket slot and one
+    dispatch instead of eight engine round-trips, so the measured
+    per-request cost at serving rungs amortizes to a small multiple of
+    a single forward (the bench A/B measures it) while top-1 keeps the
+    ensemble's +0.7 gain."""
+    from ..ops.augment import _PERM_NP, _TARGET_MAP_NP, NUM_SYMMETRIES
+
+    s = NUM_SYMMETRIES if symmetries is None else int(symmetries)
+    if not 1 <= s <= NUM_SYMMETRIES:
+        raise ValueError(f"symmetries must be in [1, {NUM_SYMMETRIES}], "
+                         f"got {symmetries!r}")
+    use_pallas = False
+    if expand_backend == "pallas":
+        from ..ops.pallas_expand import pallas_supported
+
+        # the fused gather+expand kernel when Mosaic can compile here;
+        # the XLA path (identical values) everywhere else
+        use_pallas = pallas_supported()
+        expand_backend = "xla"
+    expand_planes = get_expand_fn(expand_backend)
+    apply_fn = quant_apply if quant else policy_cnn.apply
+    # hoisted to factory scope (constant-upload discipline): uploaded
+    # once, not re-baked from host memory on every trace
+    perm = jnp.asarray(_PERM_NP[:s])          # (S, 361) gather tables
+    tmap = jnp.asarray(_TARGET_MAP_NP[:s])    # (S, 361) inverse tables
+
+    @jax.jit
+    def predict(params, packed, player, rank):
+        b, ch = packed.shape[0], packed.shape[1]
+        rep = lambda v: jnp.tile(v, s)  # noqa: E731
+        if use_pallas:
+            from ..ops.pallas_expand import expand_planes_sym_pallas
+
+            planes = expand_planes_sym_pallas(
+                packed, player, rank, symmetries=s,
+                dtype=jnp.dtype(cfg.compute_dtype))
+        else:
+            flat = packed.reshape(b, ch, NUM_POINTS)
+            views = flat[:, :, perm]              # (B, C, S, 361)
+            views = views.transpose(2, 0, 1, 3).reshape(
+                s * b, ch, *packed.shape[2:])
+            planes = expand_planes(views, rep(player), rep(rank),
+                                   dtype=jnp.dtype(cfg.compute_dtype))
+        logits = apply_fn(params, planes, cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(s, b, NUM_POINTS)
+        # view k's distribution mapped back: orig point p sits at
+        # tmap[k, p]; then the mixture average in log space
+        back = jnp.take_along_axis(logp, tmap[:, None, :], axis=2)
+        return jax.nn.logsumexp(back, axis=0) - jnp.log(float(s))
+
+    return predict
+
+
+# ---------------------------------------------------------------------------
+# the tolerance harness
+
+
+@dataclasses.dataclass(frozen=True)
+class ToleranceConfig:
+    """The floors a lossy variant must clear on EVERY rung before it may
+    serve: top-1 agreement vs the exact reference forward (the move the
+    policy would actually play), and max-abs log-prob drift over the
+    probability mass that matters (points the reference puts at least
+    ``prob_floor`` on — drift in the log of a ~0 probability is noise
+    amplification, not a serving risk). ``boards`` bounds harness cost;
+    rungs larger than it are sampled at ``boards`` rows."""
+
+    top1_floor: float = 0.99
+    drift_cap: float = 0.5
+    prob_floor: float = 1e-3
+    boards: int = 256
+    seed: int = 0
+
+
+def _random_boards(rng: np.random.Generator, n: int):
+    return (rng.integers(0, 3, size=(n, 9, 19, 19), dtype=np.uint8),
+            rng.integers(1, 3, size=n).astype(np.int32),
+            rng.integers(1, 10, size=n).astype(np.int32))
+
+
+def tolerance_report(reference, ref_params, variant_forward, var_params,
+                     buckets=(1, 8, 32, 128, 512),
+                     config: ToleranceConfig | None = None,
+                     variant: str = "int8", registry=None,
+                     sample=None) -> dict:
+    """Measure a lossy variant against its exact reference, per rung.
+
+    ``reference`` / ``variant_forward`` are engine-facing forwards of
+    the SAME program shape (plain int8 vs plain f32; fused-sym int8 vs
+    fused-sym f32 — comparing an ensemble against a non-ensemble would
+    gate the ensemble's intended prediction changes, not the
+    quantization error). Every rung dispatches at ITS jitted shape and
+    accumulates at least ``config.boards`` measured boards (small rungs
+    loop; a 1% agreement floor is meaningless over 8 boards), so the
+    per-rung percentage carries real statistical weight.
+
+    ``sample(n) -> (packed, player, rank)`` supplies the measurement
+    boards. Default is uniform random stones — a deliberately hostile
+    out-of-distribution probe. Production gating should pass real
+    positions (e.g. ``GoDataset`` rows): a trained net is DECISIVE
+    on-distribution, and an argmax flip there is a real strength risk,
+    while on noise boards the net is legitimately undecided and a flip
+    between two ~equal moves is tie-breaking, not damage
+    (docs/serving.md "Serving variants").
+
+    Returns the per-rung table plus an overall ``verdict``
+    ("pass"/"fail"), and publishes
+    ``deepgo_quant_top1_agreement{variant,bucket}`` /
+    ``deepgo_quant_logprob_drift{variant,bucket}`` gauges so a live
+    fleet's tolerance standing is scrapeable next to its throughput."""
+    cfg = config or ToleranceConfig()
+    rng = np.random.default_rng(cfg.seed)
+    if sample is None:
+        sample = lambda n: _random_boards(rng, n)  # noqa: E731
+    if registry is None:
+        from ..obs import get_registry
+
+        registry = get_registry()
+    g_top1 = registry.gauge(
+        "deepgo_quant_top1_agreement",
+        "variant-vs-reference top-1 move agreement per ladder rung")
+    g_drift = registry.gauge(
+        "deepgo_quant_logprob_drift",
+        "variant-vs-reference max-abs log-prob drift over "
+        "above-floor probability mass, per ladder rung")
+    rungs = {}
+    worst_top1, worst_drift = 1.0, 0.0
+    for b in sorted({int(x) for x in buckets}):
+        agree = total = 0
+        drift = 0.0
+        while total < cfg.boards:
+            n = min(b, cfg.boards - total)
+            packed, player, rank = sample(n)
+            if n < b:  # pad to the rung so the jitted shape is the rung's
+                pad = b - n
+                packed = np.concatenate(
+                    [packed, np.zeros((pad, 9, 19, 19), np.uint8)])
+                player = np.concatenate([player, np.ones(pad, np.int32)])
+                rank = np.concatenate([rank, np.ones(pad, np.int32)])
+            ref = np.asarray(reference(ref_params, packed, player,
+                                       rank))[:n]
+            var = np.asarray(variant_forward(var_params, packed, player,
+                                             rank))[:n]
+            agree += int(np.sum(ref.argmax(-1) == var.argmax(-1)))
+            total += n
+            mass = np.exp(ref) >= cfg.prob_floor
+            drift = max(drift, float(
+                np.max(np.where(mass, np.abs(var - ref), 0.0))))
+        top1 = agree / total
+        rungs[b] = {"boards": total, "top1_agreement": round(top1, 4),
+                    "max_abs_logprob_drift": round(drift, 5),
+                    "ok": top1 >= cfg.top1_floor and drift <= cfg.drift_cap}
+        g_top1.set(top1, variant=variant, bucket=b)
+        g_drift.set(drift, variant=variant, bucket=b)
+        worst_top1 = min(worst_top1, top1)
+        worst_drift = max(worst_drift, drift)
+    ok = all(r["ok"] for r in rungs.values())
+    return {
+        "variant": variant,
+        "verdict": "pass" if ok else "fail",
+        "top1_floor": cfg.top1_floor,
+        "drift_cap": cfg.drift_cap,
+        "worst_top1": round(worst_top1, 4),
+        "worst_drift": round(worst_drift, 5),
+        "rungs": {str(b): r for b, r in sorted(rungs.items())},
+    }
+
+
+def check_tolerance(reference, ref_params, variant_forward, var_params,
+                    buckets=(1, 8, 32, 128, 512),
+                    config: ToleranceConfig | None = None,
+                    variant: str = "int8", registry=None,
+                    sample=None) -> dict:
+    """``tolerance_report`` that REFUSES: a failing report raises a
+    typed :class:`VariantToleranceError` carrying the full measurement —
+    the gate serving/variants.py runs before a lossy variant may serve.
+    Returns the passing report otherwise."""
+    report = tolerance_report(reference, ref_params, variant_forward,
+                              var_params, buckets=buckets, config=config,
+                              variant=variant, registry=registry,
+                              sample=sample)
+    if report["verdict"] != "pass":
+        bad = {b: r for b, r in report["rungs"].items() if not r["ok"]}
+        raise VariantToleranceError(
+            f"variant {variant!r} refused to serve: tolerance floors "
+            f"(top1 >= {report['top1_floor']}, drift <= "
+            f"{report['drift_cap']}) failed on rung(s) {sorted(bad)} "
+            f"(worst top1 {report['worst_top1']}, worst drift "
+            f"{report['worst_drift']})", report)
+    return report

@@ -492,6 +492,93 @@ def sym_entry(ledger: CostLedger, cfg, bucket: int = 8,
                           analytic=8.0 * analytic_flops(cfg, bucket))
 
 
+def _quant_params_avals(cfg):
+    """ShapeDtypeStruct avals of the int8 serving pytree — derived by
+    tracing ``quantize_params`` over the f32 avals, so the AOT pass can
+    price the quantized program without any real weights existing."""
+    import jax
+
+    from ..models.quant import quantize_params
+
+    return jax.eval_shape(quantize_params, _params_avals(cfg))
+
+
+def quant_entries(ledger: CostLedger, cfg, buckets=None, forward=None,
+                  fn_name: str = "quant_forward") -> list[CostEntry]:
+    """One entry per bucket-ladder rung of the int8 serving forward
+    (``make_quant_log_prob_fn`` — per-output-channel symmetric int8
+    weights, po2 dequant folded into the conv epilogue). Conv FLOPs are
+    unchanged vs f32 (quantization moves BYTES, not multiplies), so the
+    analytic fallback reuses the f32 estimator; the interesting columns
+    are bytes-accessed and HBM, where the int8 weight tree is ~4x
+    lighter — the ``bench --gate`` MFU floor covers these rows exactly
+    like the f32 ladder's."""
+    from ..models.quant import make_quant_log_prob_fn
+    from ..serving.buckets import DEFAULT_BUCKETS
+
+    fn = forward if forward is not None else make_quant_log_prob_fn(cfg)
+    qparams = _quant_params_avals(cfg)
+    out = []
+    for b in sorted(set(int(x) for x in (buckets or DEFAULT_BUCKETS))):
+        packed, ints = _board_avals(b)
+        out.append(ledger.measure(
+            fn_name, fn, (qparams, packed, ints, ints), bucket=b,
+            analytic=analytic_flops(cfg, b)))
+    return out
+
+
+def fused_sym_entry(ledger: CostLedger, cfg, bucket: int = 8,
+                    quant: bool = False,
+                    fn_name: str | None = None) -> CostEntry:
+    """The FUSED batch-stacked dihedral ensemble
+    (``make_fused_sym_policy_fn``): one jitted program for all eight
+    views — transform, forward, inverse map, log-sum-exp average. FLOPs
+    are honestly ~8x a single forward of the same rung (the ensemble
+    computes eight forwards; fusion buys dispatch economics, not
+    arithmetic) — the acceptance A/B compares MEASURED per-request cost,
+    and this row plus the ladder row is the denominator pair. With
+    ``quant=True`` the stack runs over int8 weights (the ``int8+sym``
+    serving variant)."""
+    from ..models.quant import make_fused_sym_policy_fn
+
+    if fn_name is None:
+        fn_name = ("fused_sym_int8_forward" if quant
+                   else "fused_sym_forward")
+    fn = make_fused_sym_policy_fn(cfg, quant=quant)
+    params = _quant_params_avals(cfg) if quant else _params_avals(cfg)
+    packed, ints = _board_avals(bucket)
+    return ledger.measure(fn_name, fn, (params, packed, ints, ints),
+                          bucket=bucket,
+                          analytic=8.0 * analytic_flops(cfg, bucket))
+
+
+def variant_entries(ledger: CostLedger, cfg, variant: str, buckets=None,
+                    forward=None) -> list[CostEntry]:
+    """Price one named serving variant's forward over the ladder rungs
+    (serving/variants.py): the per-rung AOT rows ``bench --mode serving
+    --variant`` joins with the variant engine's dispatch histogram for
+    per-rung MFU. Delegates to the f32/int8 ladder helpers; sym variants
+    price the fused batch-stacked program at every rung."""
+    from ..serving.buckets import DEFAULT_BUCKETS
+    from ..serving.variants import variant_fn_name, variant_spec
+
+    if variant == "f32":
+        return ladder_entries(ledger, cfg, buckets=buckets, forward=forward)
+    if variant == "int8":
+        return quant_entries(ledger, cfg, buckets=buckets, forward=forward)
+    spec = None if forward is not None else variant_spec(cfg, variant)
+    fn = forward if forward is not None else spec.forward
+    params = (_quant_params_avals(cfg) if "int8" in variant
+              else _params_avals(cfg))
+    out = []
+    for b in sorted(set(int(x) for x in (buckets or DEFAULT_BUCKETS))):
+        packed, ints = _board_avals(b)
+        out.append(ledger.measure(
+            variant_fn_name(variant), fn, (params, packed, ints, ints),
+            bucket=b, analytic=8.0 * analytic_flops(cfg, b)))
+    return out
+
+
 # identical train-step programs are priced once per process: the
 # expert-iteration tests and loops build many short Experiments over the
 # same config, and re-lowering the same program would multiply the AOT
@@ -548,18 +635,26 @@ def eval_entry(ledger: CostLedger, cfg, batch: int, wire: str = "packed",
 def standard_ledger(model: str = "full", buckets=None,
                     train_batch: int = 256, sym_bucket: int = 8,
                     registry: MetricsRegistry | None = None,
-                    sink=None) -> CostLedger:
-    """The ``cli cost`` sweep: the serving ladder, the sym ensemble, and
-    the train/eval steps of one named model config, in one ledger.
-    ``train_batch=0`` skips the train/eval programs (their backward-pass
-    compile dominates the sweep on CPU)."""
+                    sink=None, variants: bool = True) -> CostLedger:
+    """The ``cli cost`` sweep: the serving ladder (f32 AND int8), the
+    sym ensembles (legacy unfused + fused f32 + fused int8), and the
+    train/eval steps of one named model config, in one ledger — so the
+    MFU floor and ``cli cost`` price every program the fleet can
+    actually serve, not just the f32 ladder. ``train_batch=0`` skips
+    the train/eval programs (their backward-pass compile dominates the
+    sweep on CPU); ``variants=False`` skips the int8/fused rows."""
     from ..models import policy_cnn
 
     cfg = policy_cnn.CONFIGS[model]
     ledger = CostLedger(registry=registry, sink=sink)
     ladder_entries(ledger, cfg, buckets=buckets)
+    if variants:
+        quant_entries(ledger, cfg, buckets=buckets)
     if sym_bucket:
         sym_entry(ledger, cfg, bucket=sym_bucket)
+        if variants:
+            fused_sym_entry(ledger, cfg, bucket=sym_bucket)
+            fused_sym_entry(ledger, cfg, bucket=sym_bucket, quant=True)
     if train_batch:
         train_entry(ledger, cfg, train_batch)
         eval_entry(ledger, cfg, train_batch)
@@ -580,18 +675,25 @@ def _parse_label(label: str) -> dict:
     return out
 
 
-def dispatch_seconds_by_bucket(metrics: dict) -> dict[int, float]:
+def dispatch_seconds_by_bucket(metrics: dict,
+                               engine: str | None = None) -> dict[int, float]:
     """Mean coalesced-dispatch seconds per ladder rung, from the
     ``deepgo_serving_dispatch_seconds{engine,bucket}`` histogram in a
     registry snapshot (summed across engines — a fleet's replicas share
-    one jitted program, so their rungs price identically)."""
+    one jitted program, so their rungs price identically). ``engine``
+    restricts the join to one engine's series — the variant bench runs
+    an f32 engine and an int8 engine in one process, and each variant's
+    MFU must divide ITS OWN dispatch times, not a blend."""
     m = (metrics or {}).get("deepgo_serving_dispatch_seconds") or {}
     sums: dict[int, float] = {}
     counts: dict[int, int] = {}
     for label, snap in (m.get("series") or {}).items():
         if not isinstance(snap, dict):
             continue
-        bucket = _parse_label(label).get("bucket")
+        labels = _parse_label(label)
+        if engine is not None and labels.get("engine") != engine:
+            continue
+        bucket = labels.get("bucket")
         if bucket is None:
             continue
         try:

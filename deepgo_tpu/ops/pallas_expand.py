@@ -55,11 +55,11 @@ def pallas_supported() -> bool:
     return _SUPPORTED
 
 
-def _expand_kernel(packed_ref, player_ref, rank_ref, out_ref):
-    packed = packed_ref[:].astype(jnp.int32)  # (Bb, 9, 361)
-    player = player_ref[:]  # (Bb, 1), broadcasts over the 361 lanes
-    rank = rank_ref[:]
-
+def _planes_from_packed(packed, player, rank, out_dtype):
+    """The 37-plane stack from one packed block — shared by the plain
+    expansion kernel and the fused symmetry-gather variant, so the plane
+    grammar cannot drift between them. ``packed`` (Bb, 9, 361) int32,
+    ``player``/``rank`` (Bb, 1) broadcasting over the 361 lanes."""
     stones = packed[:, 0]
     libs = packed[:, 1]
     age = packed[:, 6]
@@ -78,7 +78,28 @@ def _expand_kernel(packed_ref, player_ref, rank_ref, out_ref):
     planes += [ladder >= 1]
     planes += [jnp.zeros_like(empty)]  # the reference's dead RANK base plane
     planes += [jnp.broadcast_to(rank == i, empty.shape) for i in range(1, 10)]
-    out_ref[:] = jnp.stack(planes, axis=1).astype(out_ref.dtype)
+    return jnp.stack(planes, axis=1).astype(out_dtype)
+
+
+def _expand_kernel(packed_ref, player_ref, rank_ref, out_ref):
+    packed = packed_ref[:].astype(jnp.int32)  # (Bb, 9, 361)
+    out_ref[:] = _planes_from_packed(packed, player_ref[:], rank_ref[:],
+                                     out_ref.dtype)
+
+
+def _sym_expand_kernel(perm_ref, packed_ref, player_ref, rank_ref, out_ref):
+    """One (symmetry, batch-block) grid cell: gather the view's board
+    permutation and expand its planes in the same VMEM pass — the fused
+    transform+expand the batch-stacked dihedral ensemble dispatches
+    (models/quant.make_fused_sym_policy_fn). Every packed channel is a
+    spatial map and the player/rank planes are spatially constant, so
+    permute-then-expand equals expand-then-permute; doing the gather
+    here saves materializing the 8x packed views in HBM."""
+    perm = perm_ref[:][0]                       # (361,) this view's gather
+    packed = packed_ref[:].astype(jnp.int32)    # (Bb, 9, 361)
+    view = jnp.take(packed, perm, axis=2)
+    out_ref[:] = _planes_from_packed(view, player_ref[:], rank_ref[:],
+                                     out_ref.dtype)[None]
 
 
 @functools.partial(jax.jit, static_argnames=("dtype", "block", "interpret"))
@@ -103,3 +124,41 @@ def expand_planes_pallas(packed, player, rank, dtype=jnp.bfloat16, block=8,
     )(flat, player.reshape(b, 1), rank.reshape(b, 1))
     # NCHW-flat -> the model's NHWC
     return out.reshape(b, NUM_PLANES, 19, 19).transpose(0, 2, 3, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("symmetries", "dtype", "block",
+                                             "interpret"))
+def expand_planes_sym_pallas(packed, player, rank, symmetries=8,
+                             dtype=jnp.bfloat16, block=8, interpret=False):
+    """packed (B, 9, 19, 19) uint8; player, rank (B,) int32 ->
+    (S*B, 19, 19, 37) planes: the S dihedral views of every board,
+    symmetry-major (view k of board i at row ``k*B + i``) — exactly the
+    layout ``make_fused_sym_policy_fn``'s XLA path produces by gathering
+    views then expanding. The permutation gather rides INSIDE the
+    expansion kernel (one VMEM pass per (symmetry, batch-block) grid
+    cell), so the 8x packed views never hit HBM."""
+    from .augment import _PERM_NP
+
+    b = packed.shape[0]
+    block = block if b % block == 0 else 1
+    flat = packed.reshape(b, PACKED_CHANNELS, NUM_POINTS)
+    perm = jnp.asarray(_PERM_NP[:symmetries])
+    out = pl.pallas_call(
+        _sym_expand_kernel,
+        grid=(symmetries, b // block),
+        in_specs=[
+            pl.BlockSpec((1, NUM_POINTS), lambda s, i: (s, 0)),
+            pl.BlockSpec((block, PACKED_CHANNELS, NUM_POINTS),
+                         lambda s, i: (i, 0, 0)),
+            pl.BlockSpec((block, 1), lambda s, i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda s, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, NUM_PLANES, NUM_POINTS),
+                               lambda s, i: (s, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (symmetries, b, NUM_PLANES, NUM_POINTS), dtype),
+        interpret=interpret,
+    )(perm, flat, player.reshape(b, 1), rank.reshape(b, 1))
+    # (S, B, C, 361) NCHW-flat -> the model's NHWC, stacked on batch
+    return out.reshape(symmetries * b, NUM_PLANES, 19, 19) \
+        .transpose(0, 2, 3, 1)

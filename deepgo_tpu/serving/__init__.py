@@ -41,6 +41,17 @@ from .resilience import (CircuitBreaker, CircuitOpen,  # noqa: F401
 from .supervisor import SupervisedEngine, SupervisorConfig  # noqa: F401
 from .fleet import (TIERS, FailoverExhausted, FleetConfig,  # noqa: F401
                     FleetReloadError, FleetRouter, FleetUnavailable)
+from .variants import VARIANTS, variant_spec, verify_variant  # noqa: F401
+
+
+def __getattr__(name: str):
+    # lazy: these live in models/quant, whose jax import must not ride
+    # along with `import deepgo_tpu.serving` (see variants.py)
+    if name in ("ToleranceConfig", "VariantToleranceError"):
+        from . import variants
+
+        return getattr(variants, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def ladder_for(n_games: int, buckets=DEFAULT_BUCKETS) -> BucketLadder:
@@ -54,14 +65,58 @@ def ladder_for(n_games: int, buckets=DEFAULT_BUCKETS) -> BucketLadder:
     return BucketLadder(tuple(keep + ceil[:1]))
 
 
+def _resolve_variant(params, cfg, variant: str, expand_backend: str,
+                     verify: bool, tolerance=None, sample=None):
+    """(spec, prepared_params) for one serving variant, gated: a lossy
+    variant must pass the tolerance harness against its exact reference
+    before any engine is built over it — failure raises the typed
+    ``VariantToleranceError`` and the variant never serves
+    (docs/serving.md "Serving variants")."""
+    from . import variants as variants_mod
+
+    spec = variants_mod.variant_spec(cfg, variant, expand_backend)
+    if verify and spec.lossy:
+        variants_mod.verify_variant(cfg, params, variant,
+                                    tolerance=tolerance,
+                                    expand_backend=expand_backend,
+                                    sample=sample)
+    return spec, spec.prepare(params)
+
+
+def _stamp_variant(engine, spec):
+    """Mark an engine (or supervised engine) with its variant identity:
+    ``variant`` surfaces in fleet stats/health, ``prepare_params`` is
+    the hook FleetRouter.reload/_respawn use to re-prepare BASE params
+    for this replica's program."""
+    engine.variant = spec.name
+    engine.prepare_params = spec.prepare
+    return engine
+
+
 def policy_engine(params, cfg, config: EngineConfig | None = None,
                   expand_backend: str = "xla", metrics=None,
-                  name: str = "policy") -> InferenceEngine:
-    """Engine over the policy forward: rows are (361,) log-probs."""
-    from ..models.serving import make_log_prob_fn
+                  name: str = "policy", variant: str = "f32",
+                  verify: bool = True, tolerance=None,
+                  sample=None) -> InferenceEngine:
+    """Engine over the policy forward: rows are (361,) log-probs.
+    ``variant`` selects the serving program (serving/variants.py:
+    f32 | int8 | sym | int8+sym); lossy variants are tolerance-gated
+    before the engine exists. The f32 path keeps its historical
+    contract — a FRESH jitted forward per engine, so the per-engine
+    compile counter (zero-recompile tests, xlacheck sentinel) counts
+    this engine's shapes alone; variant forwards are process-memoized
+    per (cfg, variant) so replicas and A/B arms share warm caches."""
+    if variant == "f32":
+        from ..models.serving import make_log_prob_fn
 
-    return InferenceEngine(make_log_prob_fn(cfg, expand_backend), params,
-                           config=config, name=name, metrics=metrics)
+        return InferenceEngine(make_log_prob_fn(cfg, expand_backend),
+                               params, config=config, name=name,
+                               metrics=metrics)
+    spec, prepared = _resolve_variant(params, cfg, variant, expand_backend,
+                                      verify, tolerance, sample)
+    return _stamp_variant(
+        InferenceEngine(spec.forward, prepared, config=config, name=name,
+                        metrics=metrics), spec)
 
 
 def value_engine(params, cfg, config: EngineConfig | None = None,
@@ -77,19 +132,29 @@ def supervised_policy_engine(params, cfg,
                              config: EngineConfig | None = None,
                              supervisor: SupervisorConfig | None = None,
                              expand_backend: str = "xla", metrics=None,
-                             name: str = "policy") -> SupervisedEngine:
+                             name: str = "policy", variant: str = "f32",
+                             verify: bool = True, tolerance=None,
+                             sample=None) -> SupervisedEngine:
     """Resilient engine over the policy forward: an InferenceEngine
     factory under a SupervisedEngine (auto-restart, poison isolation,
     breaker, deadline shedding). The jitted forward is built ONCE and
     closed over, so a restart reuses the warm jit cache — replayed
-    requests never recompile."""
-    from ..models.serving import make_log_prob_fn
+    requests never recompile. ``variant`` as in ``policy_engine`` (f32
+    keeps a per-call forward; variant forwards are process-memoized)."""
+    if variant == "f32":
+        from ..models.serving import make_log_prob_fn
 
-    forward = make_log_prob_fn(cfg, expand_backend)
-    return SupervisedEngine(
-        lambda: InferenceEngine(forward, params, config=config, name=name,
-                                metrics=metrics),
-        config=supervisor, name=name, metrics=metrics)
+        forward = make_log_prob_fn(cfg, expand_backend)
+        return SupervisedEngine(
+            lambda: InferenceEngine(forward, params, config=config,
+                                    name=name, metrics=metrics),
+            config=supervisor, name=name, metrics=metrics)
+    spec, prepared = _resolve_variant(params, cfg, variant, expand_backend,
+                                      verify, tolerance, sample)
+    return _stamp_variant(SupervisedEngine(
+        lambda: InferenceEngine(spec.forward, prepared, config=config,
+                                name=name, metrics=metrics),
+        config=supervisor, name=name, metrics=metrics), spec)
 
 
 def supervised_value_engine(params, cfg,
@@ -113,23 +178,63 @@ def fleet_policy_engine(params, cfg, replicas: int = 2,
                         fleet: FleetConfig | None = None,
                         supervisor: SupervisorConfig | None = None,
                         expand_backend: str = "xla", metrics=None,
-                        name: str = "policy-fleet") -> FleetRouter:
+                        name: str = "policy-fleet",
+                        variants=None, verify: bool = True,
+                        tolerance=None, sample=None) -> FleetRouter:
     """A FleetRouter of N supervised policy replicas sharing ONE jitted
-    forward — so warmup compiles each ladder rung once for the whole
-    fleet, and restarts, respawns, and ``reload`` weight swaps all reuse
-    the warm jit cache (zero recompiles, the hot-reload contract)."""
-    from ..models.serving import make_log_prob_fn
+    forward per variant — so warmup compiles each ladder rung once for
+    the whole fleet, and restarts, respawns, and ``reload`` weight swaps
+    all reuse the warm jit cache (zero recompiles, the hot-reload
+    contract).
 
-    forward = make_log_prob_fn(cfg, expand_backend)
+    ``variants`` (a name or a list — serving/variants.py) assigns a
+    serving variant to each replica round-robin: ``("f32", "int8")``
+    over 4 replicas serves 2 full-precision and 2 quantized replicas
+    behind one router, hot-swappable via ``reload`` (each replica's
+    ``prepare_params`` hook re-prepares the new BASE checkpoint for its
+    own program). Lossy variants are tolerance-gated ONCE here, before
+    any replica exists — a failing variant refuses to serve."""
+    from . import variants as variants_mod
+
+    if variants is None:
+        variants = ("f32",)
+    elif isinstance(variants, str):
+        variants = (variants,)
+    if set(variants) == {"f32"}:
+        # the historical pure-f32 fleet: ONE fresh jitted forward per
+        # fleet call, shared by its replicas — per-fleet compile
+        # counters stay scoped to this fleet's own shapes
+        from ..models.serving import make_log_prob_fn
+
+        forward = make_log_prob_fn(cfg, expand_backend)
+
+        def make_f32_replica(i: int) -> SupervisedEngine:
+            return SupervisedEngine(
+                lambda: InferenceEngine(forward, params, config=config,
+                                        name=f"{name}-{i}",
+                                        metrics=metrics),
+                config=supervisor, name=f"{name}-{i}", metrics=metrics)
+
+        return FleetRouter(make_f32_replica, replicas, config=fleet,
+                           name=name, metrics=metrics, params=params)
+    specs = {}
+    for v in dict.fromkeys(variants):  # verify each distinct variant once
+        spec, prepared = _resolve_variant(params, cfg, v, expand_backend,
+                                          verify, tolerance, sample)
+        specs[v] = (spec, prepared)
+    assignment = [variants[i % len(variants)] for i in range(replicas)]
+    for v in specs:
+        variants_mod._note_serving(v, assignment.count(v))
 
     def make_replica(i: int) -> SupervisedEngine:
-        return SupervisedEngine(
-            lambda: InferenceEngine(forward, params, config=config,
+        spec, prepared = specs[assignment[i]]
+        return _stamp_variant(SupervisedEngine(
+            lambda: InferenceEngine(spec.forward, prepared, config=config,
                                     name=f"{name}-{i}", metrics=metrics),
-            config=supervisor, name=f"{name}-{i}", metrics=metrics)
+            config=supervisor, name=f"{name}-{i}", metrics=metrics), spec)
 
     return FleetRouter(make_replica, replicas, config=fleet, name=name,
-                       metrics=metrics)
+                       metrics=metrics, params=params)
 
 
 def fleet_value_engine(params, cfg, replicas: int = 2,
@@ -160,31 +265,42 @@ _SHARED: dict[tuple, InferenceEngine] = {}
 
 
 def _shared(kind: str, factory, params, cfg, config: EngineConfig | None,
-            supervised: bool, fleet: int = 1):
-    key = (kind, supervised, fleet, id(params), cfg, config)
+            supervised: bool, fleet: int = 1, variant: str = "f32"):
+    key = (kind, supervised, fleet, id(params), cfg, config, variant)
     engine = _SHARED.get(key)
     if (engine is None or engine._closing.is_set()
             or getattr(engine, "_failed", None) is not None):
+        kw = {} if kind == "value" else {"variant": variant}
+        # variant engines get distinct names so their metrics series
+        # (and the roofline's per-engine join) never merge with f32's
+        suffix = "" if variant == "f32" else f"-{variant}"
         if fleet > 1:
             fleet_factory = (fleet_policy_engine if kind == "policy"
                              else fleet_value_engine)
+            if kind == "policy":
+                kw = {"variants": variant}
             engine = _SHARED[key] = fleet_factory(
                 params, cfg, replicas=fleet, config=config,
-                name=f"shared-{kind}-fleet")
+                name=f"shared-{kind}-fleet{suffix}", **kw)
         else:
             engine = _SHARED[key] = factory(params, cfg, config=config,
-                                            name=f"shared-{kind}")
+                                            name=f"shared-{kind}{suffix}",
+                                            **kw)
     return engine
 
 
 def shared_policy_engine(params, cfg, config: EngineConfig | None = None,
-                         supervised: bool = False, fleet: int = 1):
+                         supervised: bool = False, fleet: int = 1,
+                         variant: str = "f32"):
     """``fleet > 1`` returns a FleetRouter of that many supervised
     replicas (replica supervision is implied — every replica is a
-    SupervisedEngine); otherwise the single shared engine as before."""
+    SupervisedEngine); otherwise the single shared engine as before.
+    ``variant`` selects the serving program (serving/variants.py) —
+    memoized per (checkpoint, variant), so an int8 champion and the f32
+    one coexist as distinct shared engines for a live A/B."""
     return _shared("policy",
                    supervised_policy_engine if supervised else policy_engine,
-                   params, cfg, config, supervised, fleet)
+                   params, cfg, config, supervised, fleet, variant)
 
 
 def shared_value_engine(params, cfg, config: EngineConfig | None = None,

@@ -181,7 +181,7 @@ class FleetRouter:
     def __init__(self, make_replica, replicas: int,
                  config: FleetConfig | None = None, name: str = "fleet",
                  metrics=None, clock=time.monotonic, sleep=time.sleep,
-                 rng=None):
+                 rng=None, params=None):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.config = config or FleetConfig()
@@ -199,7 +199,12 @@ class FleetRouter:
         self._closing = threading.Event()
         self._events: queue.Queue = queue.Queue()
         self._rr = 0                       # round-robin tie-break cursor
-        self._current_params = None        # set by reload; respawns converge
+        # the BASE (full-precision) params, the reload/respawn source of
+        # truth: replicas serving a lossy variant carry a
+        # ``prepare_params`` hook (serving/variants.py) the router
+        # applies before every swap, so the base tree — not a variant's
+        # int8 pytree — is always the checkpoint-load template
+        self._current_params = params      # updated by reload; respawns converge
         self._reload_mutex = make_lock(f"fleet.{name}.reload")
         self._failovers = 0
         self._respawns = 0
@@ -612,7 +617,7 @@ class FleetRouter:
             try:
                 eng = self._make_replica(rep.idx)
                 if self._current_params is not None:
-                    eng.set_params(self._current_params)
+                    self._apply_params(eng, self._current_params)
                 if self.config.warm_on_respawn:
                     eng.warmup()
             except Exception:
@@ -688,7 +693,7 @@ class FleetRouter:
                             f"replica {rep.idx} ({swapped} already "
                             "swapped; restarts/respawns will converge on "
                             "the new weights)") from e
-                    rep.engine.set_params(params)
+                    self._apply_params(rep.engine, params)
                     swapped += 1
                 finally:
                     with self._lock:
@@ -704,6 +709,18 @@ class FleetRouter:
                                     replicas=swapped,
                                     seconds=round(dt, 4))
             return {"replicas": swapped, "seconds": dt}
+
+    @staticmethod
+    def _apply_params(engine, base_params) -> None:
+        """Swap BASE params into one replica, through its variant's
+        ``prepare_params`` hook when it carries one (an int8 replica
+        re-quantizes the new checkpoint; an f32 replica takes it as
+        is) — the single point reload and respawn share, so a variant
+        replica can never be handed the raw f32 tree by one path and
+        the prepared one by the other."""
+        prepare = getattr(engine, "prepare_params", None)
+        engine.set_params(prepare(base_params) if prepare is not None
+                          else base_params)
 
     def _resolve_params(self, new):
         if isinstance(new, (str, os.PathLike)):
@@ -771,6 +788,9 @@ class FleetRouter:
         for r in reps:
             entry = {"replica": r.idx, "state": r.state,
                      "pending": r.pending, "respawns": r.respawns}
+            variant = getattr(r.engine, "variant", None)
+            if variant is not None:
+                entry["variant"] = variant
             if r.state in ("serving", "draining"):
                 try:
                     h = r.engine.health()
@@ -816,6 +836,9 @@ class FleetRouter:
                 s = {"error": repr(e)}
             s["replica"] = r.idx
             s["state"] = r.state
+            variant = getattr(r.engine, "variant", None)
+            if variant is not None:
+                s["variant"] = variant
             boards += s.get("boards") or 0
             replica_stats.append(s)
         with self._lock:

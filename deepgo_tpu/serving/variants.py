@@ -1,0 +1,164 @@
+"""Named serving variants: f32 | int8 | sym | int8+sym, hot-swappable.
+
+A *variant* is a (forward program, params preparation) pair sharing the
+engine-facing signature ``forward(params, packed, player, rank) ->
+(B, 361)``, so every layer above — bucket ladder, engine, supervisor,
+fleet router, agents — runs it unchanged:
+
+  f32        the reference ``make_log_prob_fn`` forward (identity prep)
+  int8       per-output-channel symmetric int8 weights with power-of-two
+             scales, dequant folded into the conv epilogue
+             (models/quant.py) — prep quantizes the f32 pytree
+  sym        the fused 8-fold dihedral ensemble over f32 weights
+             (``make_fused_sym_policy_fn``): one jitted program stacks
+             all eight views on the batch axis
+  int8+sym   the ensemble over int8 weights — both savings compose
+
+Variants are assigned PER REPLICA (``fleet_policy_engine(variants=...)``
+round-robins the list across replicas), so one fleet can serve a
+quantized champion next to the full-precision one and the arena /
+``cli serve`` can A/B them live. Hot reload rides the existing
+``fleet.reload`` path: the router keeps BASE f32 params as the source
+of truth and each replica's engine carries a ``prepare_params`` hook the
+router applies during reloads and respawns — an int8 replica re-
+quantizes the new checkpoint in place, with zero dropped futures and
+zero recompiles (the quantized pytree's shapes/dtypes never change).
+
+Lossy variants are gated: :func:`verify_variant` runs the tolerance
+harness (models/quant.check_tolerance — per-rung top-1 agreement +
+max-abs log-prob drift vs the exact reference of the same program
+shape) and a failure raises the typed ``VariantToleranceError`` — the
+variant REFUSES to serve rather than silently costing dan rank. The
+arena strength gate (``match.standard_gate`` via ``arena --variant-a/
+--variant-b``) and the bench regression gate (``bench --mode serving
+--variant``) are the other two legs of the triple gate
+(docs/serving.md "Serving variants").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .buckets import DEFAULT_BUCKETS
+
+VARIANTS = ("f32", "int8", "sym", "int8+sym")
+
+# models/quant (and with it jax) loads lazily: `import deepgo_tpu.serving`
+# must stay jax-free — the fleet/engine tests drive duck-typed replicas
+# with no device stack at all
+_LAZY = ("ToleranceConfig", "VariantToleranceError")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        from ..models import quant
+
+        return getattr(quant, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+# gauge: which variants this process is serving, how many replicas each
+_g_serving = None
+
+
+def _note_serving(variant: str, replicas: int) -> None:
+    global _g_serving
+    if _g_serving is None:
+        from ..obs import get_registry
+
+        _g_serving = get_registry().gauge(
+            "deepgo_quant_variants_serving",
+            "replicas currently built per serving variant")
+    _g_serving.set(replicas, variant=variant)
+
+
+def variant_fn_name(variant: str) -> str:
+    """The cost-ledger entrypoint name for one variant's forward — one
+    definition so bench joins and ``cli cost`` rows can never drift."""
+    return {"f32": "policy_forward", "int8": "quant_forward",
+            "sym": "fused_sym_forward",
+            "int8+sym": "fused_sym_int8_forward"}[variant]
+
+
+@dataclasses.dataclass(frozen=True)
+class VariantSpec:
+    """One variant, resolved for a model config: the jitted forward (one
+    per process per (cfg, variant) — every replica of a variant shares
+    its warm jit cache), the base->serving params preparation, and the
+    reference pair the tolerance harness compares against (None for
+    exact variants: nothing to gate)."""
+
+    name: str
+    forward: object
+    prepare: object                  # base f32 params -> serving params
+    reference: object | None         # exact forward of the SAME shape
+    reference_prepare: object | None
+
+    @property
+    def lossy(self) -> bool:
+        return self.reference is not None
+
+
+# one jitted program per (cfg, variant, expand_backend) per process —
+# replicas, respawns, and reloads all reuse the same warm jit cache
+_SPECS: dict[tuple, VariantSpec] = {}
+
+
+def variant_spec(cfg, variant: str,
+                 expand_backend: str = "xla") -> VariantSpec:
+    """Resolve (and memoize) one variant for a model config."""
+    if variant not in VARIANTS:
+        raise ValueError(f"unknown variant {variant!r}; valid: {VARIANTS}")
+    key = (cfg, variant, expand_backend)
+    spec = _SPECS.get(key)
+    if spec is not None:
+        return spec
+    from ..models.quant import (make_fused_sym_policy_fn,
+                                make_quant_log_prob_fn, quantize_params)
+    from ..models.serving import make_log_prob_fn
+
+    ident = lambda p: p  # noqa: E731
+    if variant == "f32":
+        spec = VariantSpec(variant, make_log_prob_fn(cfg, expand_backend),
+                           ident, None, None)
+    elif variant == "int8":
+        spec = VariantSpec(variant, make_quant_log_prob_fn(cfg,
+                                                           expand_backend),
+                           quantize_params,
+                           make_log_prob_fn(cfg, expand_backend), ident)
+    elif variant == "sym":
+        spec = VariantSpec(variant,
+                           make_fused_sym_policy_fn(
+                               cfg, expand_backend=expand_backend),
+                           ident, None, None)
+    else:  # int8+sym
+        spec = VariantSpec(variant,
+                           make_fused_sym_policy_fn(
+                               cfg, quant=True,
+                               expand_backend=expand_backend),
+                           quantize_params,
+                           make_fused_sym_policy_fn(
+                               cfg, expand_backend=expand_backend), ident)
+    _SPECS[key] = spec
+    return spec
+
+
+def verify_variant(cfg, params, variant: str,
+                   buckets=DEFAULT_BUCKETS,
+                   tolerance=None,
+                   expand_backend: str = "xla", sample=None) -> dict:
+    """The serve gate for one variant over one checkpoint: exact
+    variants pass trivially (``{"verdict": "pass", "exact": True}``);
+    lossy ones run the tolerance harness against their exact reference
+    and RAISE the typed ``VariantToleranceError`` below the floors —
+    callers never get a serving handle for a variant that failed.
+    ``sample(n)`` supplies measurement boards (pass real positions for
+    production gating — see models/quant.tolerance_report)."""
+    from ..models.quant import check_tolerance
+
+    spec = variant_spec(cfg, variant, expand_backend)
+    if not spec.lossy:
+        return {"variant": variant, "verdict": "pass", "exact": True}
+    return check_tolerance(
+        spec.reference, spec.reference_prepare(params),
+        spec.forward, spec.prepare(params),
+        buckets=buckets, config=tolerance, variant=variant, sample=sample)

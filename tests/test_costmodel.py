@@ -462,8 +462,13 @@ def test_cli_cost_json(capsys):
         main(["cost", "--model", "small", "--buckets", "1,8",
               "--train-batch", "0", "--sym-bucket", "0", "--json"])
         out = json.loads(capsys.readouterr().out)
+        # the ladder is priced for BOTH the f32 and the int8 serving
+        # programs (ISSUE 13: the MFU floor covers every program the
+        # fleet can serve, not just the f32 ladder)
         assert set(out["entries"]) == {"policy_forward/b1",
-                                      "policy_forward/b8"}
+                                       "policy_forward/b8",
+                                       "quant_forward/b1",
+                                       "quant_forward/b8"}
         for row in out["entries"].values():
             assert row["flops"] > 0 and row["mfu"] is None
         # the command installs the ledger for a live /cost route

@@ -1,0 +1,496 @@
+"""Int8 quantized serving + fused dihedral ensemble + serving variants.
+
+The load-bearing contracts:
+
+  * po2 per-output-channel int8 is EXACTLY trackable: weights already on
+    the int8 grid round-trip bit-identically through the quantized
+    forward (the epilogue dequant commutes through the f32 accumulation
+    and bf16 downcast), so tolerance measures weight rounding alone;
+  * the fused sym ensemble with symmetries=1 is BITWISE the plain
+    forward (plumbing check), and at 8 views reproduces the reference
+    probability mixture and is equivariant by construction;
+  * per-rung tolerance floors (1/8/32/128/512) pass on a representable
+    net and genuinely REFUSE (typed) on a near-uniform random net whose
+    argmax quant noise flips — a failing variant never serves;
+  * a mixed-variant fleet performs zero steady-state compiles under
+    DEEPGO_XLACHECK=1 and hot-swaps weights mid-traffic with every
+    future resolving to exactly the old- or new-checkpoint output;
+  * the Pallas fused gather+expand kernel matches the XLA path bit for
+    bit (interpret mode), and the cost ledger prices every variant
+    program under the right entrypoint names.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepgo_tpu.models import ModelConfig, init, quant
+from deepgo_tpu.models.serving import make_log_prob_fn, make_sym_policy_fn
+from deepgo_tpu.serving import (EngineConfig, VariantToleranceError,
+                                fleet_policy_engine, policy_engine,
+                                variant_spec, verify_variant)
+
+CFG = ModelConfig(num_layers=2, channels=8)
+ECFG = EngineConfig(buckets=(1, 8), max_wait_ms=0.0)
+FAST_TOL = quant.ToleranceConfig(boards=32)
+
+
+def boards(n, seed=0, hi=3):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, hi, size=(n, 9, 19, 19), dtype=np.uint8),
+            rng.integers(1, 3, size=n).astype(np.int32),
+            rng.integers(1, 10, size=n).astype(np.int32))
+
+
+def grid_net(cfg=CFG, seed=0, sharp=4.0):
+    """A net the int8 scheme represents exactly: weights snapped onto
+    the po2 grid (quantization is then lossless) plus a sharp final
+    per-position bias so argmax has real margins."""
+    params = init(jax.random.key(seed), cfg)
+    snapped = quant.dequantize_params(quant.quantize_params(params))
+    rng = np.random.default_rng(seed)
+    snapped["layers"][-1]["b"] = jnp.asarray(
+        rng.normal(0.0, sharp, size=(19, 19, 1)).astype(np.float32))
+    return snapped
+
+
+class TestQuantization:
+    def test_quantize_shapes_dtypes_and_po2_scales(self):
+        params = init(jax.random.key(0), CFG)
+        qp = quant.quantize_params(params)
+        for layer, qlayer in zip(params["layers"], qp["layers"]):
+            w = np.asarray(layer["w"])
+            assert np.asarray(qlayer["w_q"]).dtype == np.int8
+            assert qlayer["w_q"].shape == w.shape
+            scale = np.asarray(qlayer["w_scale"])
+            assert scale.shape == (w.shape[-1],)
+            assert (scale > 0).all()
+            # power-of-two scales: log2 is integral
+            assert np.allclose(np.log2(scale), np.round(np.log2(scale)))
+            # symmetric: round-trip error bounded by half a step
+            err = np.abs(np.asarray(qlayer["w_q"], np.float32) * scale - w)
+            assert (err <= scale / 2 + 1e-7).all()
+
+    def test_grid_weights_roundtrip_bitwise(self):
+        params = grid_net()
+        qp = quant.quantize_params(params)
+        dq = quant.dequantize_params(qp)
+        for a, b in zip(params["layers"], dq["layers"]):
+            assert (np.asarray(a["w"]) == np.asarray(b["w"])).all()
+
+    def test_grid_net_int8_forward_bitwise_equals_f32(self):
+        # THE po2 identity: the epilogue-folded int8 forward is
+        # numerically equivalent to the reference forward over the
+        # dequantized weights — for grid weights, bit-identical
+        params = grid_net()
+        qp = quant.quantize_params(params)
+        ref = make_log_prob_fn(CFG)
+        var = quant.make_quant_log_prob_fn(CFG)
+        pk, pl, rk = boards(16, seed=1)
+        a = np.asarray(ref(params, pk, pl, rk))
+        b = np.asarray(var(qp, pk, pl, rk))
+        assert (a == b).all()
+
+    def test_nongrid_equals_reference_over_dequantized_weights(self):
+        # arbitrary weights: int8 path == reference path run on the
+        # dequantized tree, bit for bit — zero compute-path noise is
+        # what makes the tolerance floors meaningful
+        params = init(jax.random.key(2), CFG)
+        qp = quant.quantize_params(params)
+        ref = make_log_prob_fn(CFG)
+        var = quant.make_quant_log_prob_fn(CFG)
+        pk, pl, rk = boards(8, seed=2)
+        a = np.asarray(ref(quant.dequantize_params(qp), pk, pl, rk))
+        b = np.asarray(var(qp, pk, pl, rk))
+        assert (a == b).all()
+
+
+class TestFusedSym:
+    def test_sym_disabled_bitwise_equals_plain(self):
+        # symmetries=1 is the identity view alone: the fused program
+        # must reproduce the plain forward BIT FOR BIT
+        params = init(jax.random.key(0), CFG)
+        plain = make_log_prob_fn(CFG)
+        one = quant.make_fused_sym_policy_fn(CFG, symmetries=1)
+        pk, pl, rk = boards(8, seed=3)
+        assert (np.asarray(plain(params, pk, pl, rk))
+                == np.asarray(one(params, pk, pl, rk))).all()
+
+    def test_fused_matches_reference_mixture(self):
+        # log-sum-exp averaging == log of the softmax mixture the
+        # unfused make_sym_policy_fn computes
+        cfg = ModelConfig(num_layers=2, channels=8,
+                          compute_dtype="float32")
+        params = init(jax.random.key(1), cfg)
+        fused = quant.make_fused_sym_policy_fn(cfg)
+        old = make_sym_policy_fn(cfg)
+        pk, pl, rk = boards(4, seed=5)
+        np.testing.assert_allclose(
+            np.asarray(fused(params, pk, pl, rk)),
+            np.asarray(old(params, pk, pl, rk)), rtol=2e-4, atol=1e-5)
+
+    def test_fused_is_equivariant(self):
+        from deepgo_tpu.ops.augment import _PERM_NP, _TARGET_MAP_NP
+
+        cfg = ModelConfig(num_layers=2, channels=8,
+                          compute_dtype="float32")
+        params = init(jax.random.key(1), cfg)
+        fused = quant.make_fused_sym_policy_fn(cfg)
+        pk, pl, rk = boards(4, seed=6)
+        base = np.asarray(fused(params, pk, pl, rk))
+        k = 5
+        flat = pk.reshape(4, 9, 361)
+        t_pk = flat[:, :, _PERM_NP[k]].reshape(4, 9, 19, 19)
+        t_out = np.asarray(fused(params, t_pk, pl, rk))
+        np.testing.assert_allclose(t_out[:, _TARGET_MAP_NP[k]], base,
+                                   rtol=2e-4, atol=1e-6)
+
+    def test_int8_sym_bitwise_on_grid_net(self):
+        params = grid_net()
+        qp = quant.quantize_params(params)
+        f8 = quant.make_fused_sym_policy_fn(CFG)
+        f8q = quant.make_fused_sym_policy_fn(CFG, quant=True)
+        pk, pl, rk = boards(8, seed=7)
+        assert (np.asarray(f8(params, pk, pl, rk))
+                == np.asarray(f8q(qp, pk, pl, rk))).all()
+
+    def test_bad_symmetries_rejected(self):
+        with pytest.raises(ValueError):
+            quant.make_fused_sym_policy_fn(CFG, symmetries=9)
+
+
+class TestToleranceHarness:
+    def test_grid_net_passes_every_rung(self):
+        # the full ladder, every rung at its own jitted shape, pooled
+        # boards — bitwise representability means exactly 1.0 / 0.0
+        params = grid_net()
+        qp = quant.quantize_params(params)
+        rep = quant.tolerance_report(
+            make_log_prob_fn(CFG), params,
+            quant.make_quant_log_prob_fn(CFG), qp,
+            buckets=(1, 8, 32, 128, 512), config=FAST_TOL)
+        assert rep["verdict"] == "pass"
+        assert set(rep["rungs"]) == {"1", "8", "32", "128", "512"}
+        for rung in rep["rungs"].values():
+            assert rung["top1_agreement"] == 1.0
+            assert rung["max_abs_logprob_drift"] == 0.0
+
+    def test_undecided_net_refuses_typed(self):
+        # a near-uniform random-init net: quant noise flips argmax on
+        # real tie-breaks, the floors fail, and the variant REFUSES —
+        # this is the genuine failure path, not a rigged threshold
+        params = init(jax.random.key(9), CFG)
+        with pytest.raises(VariantToleranceError) as ei:
+            verify_variant(CFG, params, "int8", buckets=(8, 32),
+                           tolerance=quant.ToleranceConfig(boards=64))
+        report = ei.value.report
+        assert report["verdict"] == "fail"
+        assert report["worst_top1"] < 0.99
+
+    def test_exact_variants_pass_trivially(self):
+        params = init(jax.random.key(0), CFG)
+        for v in ("f32", "sym"):
+            out = verify_variant(CFG, params, v)
+            assert out == {"variant": v, "verdict": "pass", "exact": True}
+
+    def test_int8_sym_gated_against_f32_sym_reference(self):
+        params = grid_net()
+        out = verify_variant(CFG, params, "int8+sym", buckets=(1, 8),
+                             tolerance=FAST_TOL)
+        assert out["verdict"] == "pass"
+        assert out["variant"] == "int8+sym"
+
+    def test_tolerance_publishes_gauges(self):
+        from deepgo_tpu.obs import get_registry
+
+        params = grid_net()
+        qp = quant.quantize_params(params)
+        quant.tolerance_report(
+            make_log_prob_fn(CFG), params,
+            quant.make_quant_log_prob_fn(CFG), qp, buckets=(8,),
+            config=FAST_TOL, variant="int8")
+        snap = get_registry().snapshot()["metrics"]
+        assert "deepgo_quant_top1_agreement" in snap
+        assert "deepgo_quant_logprob_drift" in snap
+
+    def test_unknown_variant_rejected(self):
+        with pytest.raises(ValueError):
+            variant_spec(CFG, "fp4")
+
+
+class TestVariantEngines:
+    def test_engine_stamped_and_bitwise(self):
+        params = grid_net()
+        eng = policy_engine(params, CFG, config=ECFG, variant="int8",
+                            tolerance=FAST_TOL, name="q-stamp")
+        try:
+            assert eng.variant == "int8"
+            assert eng.prepare_params is quant.quantize_params
+            pk, pl, rk = boards(4, seed=11)
+            got = eng.evaluate(pk, pl, rk)
+            ref = np.asarray(make_log_prob_fn(CFG)(params, pk, pl, rk))
+            assert (got == ref).all()
+        finally:
+            eng.close()
+
+    def test_mixed_fleet_zero_steady_state_recompiles_xlacheck(self):
+        from deepgo_tpu.analysis import xlacheck
+
+        params = grid_net()
+        xlacheck.enable(True)
+        xlacheck.reset()
+        try:
+            fleet = fleet_policy_engine(
+                params, CFG, replicas=2, config=ECFG,
+                variants=("f32", "int8"), tolerance=FAST_TOL,
+                name="q-xla")
+            try:
+                fleet.warmup()
+                warm = fleet.compile_cache_size()
+                # mixed-count traffic over both variants' replicas
+                for n, seed in ((1, 1), (3, 2), (8, 3), (5, 4)):
+                    pk, pl, rk = boards(n, seed=seed)
+                    fleet.evaluate(pk, pl, rk)
+                report = xlacheck.report()
+                assert report["steady_state_compiles"] == 0
+                assert report["transfers"] == []
+                assert fleet.compile_cache_size() == warm
+            finally:
+                fleet.close()
+        finally:
+            xlacheck.enable(None)
+            xlacheck.reset()
+
+    def test_hot_swap_mid_reload_exactly_old_or_new(self):
+        # futures streaming through a mixed-variant fleet during a
+        # reload must each resolve to EXACTLY the old- or new-checkpoint
+        # output (grid nets: the int8 replica's rows are bitwise f32's,
+        # so the old/new reference pair covers both variants)
+        old_params = grid_net(seed=0)
+        new_params = grid_net(seed=5)
+        ref_fn = make_log_prob_fn(CFG)
+        fleet = fleet_policy_engine(
+            old_params, CFG, replicas=2, config=ECFG,
+            variants=("f32", "int8"), tolerance=FAST_TOL, name="q-swap")
+        try:
+            fleet.warmup()
+            warm = fleet.compile_cache_size()
+            pk, pl, rk = boards(6, seed=13)
+            old_ref = np.asarray(ref_fn(old_params, pk, pl, rk))
+            new_ref = np.asarray(ref_fn(new_params, pk, pl, rk))
+            stop = threading.Event()
+            results, errors = [], []
+
+            def submitter(i):
+                while not stop.is_set():
+                    try:
+                        row = fleet.submit(pk[i], int(pl[i]),
+                                           int(rk[i])).result(timeout=10)
+                        results.append((i, np.asarray(row)))
+                    except Exception as e:  # noqa: BLE001 — the assert
+                        errors.append(repr(e))
+                        return
+
+            threads = [threading.Thread(target=submitter, args=(i,),
+                                        name=f"q-swap-{i}", daemon=True)
+                       for i in range(len(pk))]
+            for t in threads:
+                t.start()
+            out = fleet.reload(new_params)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            assert out["replicas"] == 2
+            assert not errors, f"futures dropped mid-reload: {errors[:3]}"
+            assert results
+            for i, row in results:
+                ok = (row == old_ref[i]).all() or (row == new_ref[i]).all()
+                assert ok, f"row {i} is neither old nor new output"
+            # the swap (including the int8 replica's re-quantization)
+            # must not recompile: same shapes, same dtypes, warm cache
+            assert fleet.compile_cache_size() == warm
+            # steady state converges on the new checkpoint
+            post = fleet.evaluate(pk, pl, rk)
+            assert (post == new_ref).all()
+        finally:
+            fleet.close()
+
+    def test_failing_variant_never_builds_a_fleet(self):
+        params = init(jax.random.key(9), CFG)  # undecided net
+        with pytest.raises(VariantToleranceError):
+            fleet_policy_engine(params, CFG, replicas=2, config=ECFG,
+                                variants=("f32", "int8"),
+                                tolerance=quant.ToleranceConfig(boards=64),
+                                name="q-refuse")
+
+
+class TestPallasSymExpand:
+    def test_interpret_parity_with_xla_path(self):
+        from deepgo_tpu.ops import expand_planes
+        from deepgo_tpu.ops.augment import _PERM_NP
+        from deepgo_tpu.ops.pallas_expand import expand_planes_sym_pallas
+
+        pk, pl, rk = boards(4, seed=17, hi=7)
+        flat = pk.reshape(4, 9, 361)
+        views = flat[:, :, _PERM_NP].transpose(2, 0, 1, 3) \
+            .reshape(32, 9, 19, 19)
+        ref = np.asarray(expand_planes(
+            jnp.asarray(views), jnp.asarray(np.tile(pl, 8)),
+            jnp.asarray(np.tile(rk, 8)), dtype=jnp.float32))
+        got = np.asarray(expand_planes_sym_pallas(
+            jnp.asarray(pk), jnp.asarray(pl), jnp.asarray(rk),
+            dtype=jnp.float32, interpret=True))
+        assert (ref == got).all()
+
+    def test_block_fallback_for_odd_batches(self):
+        from deepgo_tpu.ops import expand_planes
+        from deepgo_tpu.ops.augment import _PERM_NP
+        from deepgo_tpu.ops.pallas_expand import expand_planes_sym_pallas
+
+        pk, pl, rk = boards(3, seed=18, hi=7)
+        got = np.asarray(expand_planes_sym_pallas(
+            jnp.asarray(pk), jnp.asarray(pl), jnp.asarray(rk),
+            dtype=jnp.float32, interpret=True))
+        flat = pk.reshape(3, 9, 361)
+        views = flat[:, :, _PERM_NP].transpose(2, 0, 1, 3) \
+            .reshape(24, 9, 19, 19)
+        ref = np.asarray(expand_planes(
+            jnp.asarray(views), jnp.asarray(np.tile(pl, 8)),
+            jnp.asarray(np.tile(rk, 8)), dtype=jnp.float32))
+        assert (ref == got).all()
+
+
+class TestCostLedgerVariants:
+    def test_variant_entries_named_and_bucketed(self):
+        from deepgo_tpu.obs import costmodel
+        from deepgo_tpu.serving.variants import variant_fn_name
+
+        led = costmodel.CostLedger()
+        costmodel.quant_entries(led, CFG, buckets=(1, 8))
+        costmodel.fused_sym_entry(led, CFG, bucket=8)
+        costmodel.fused_sym_entry(led, CFG, bucket=8, quant=True)
+        costmodel.variant_entries(led, CFG, "sym", buckets=(1,))
+        keys = {e.key for e in led.entries}
+        assert {"quant_forward/b1", "quant_forward/b8",
+                "fused_sym_forward/b8", "fused_sym_int8_forward/b8",
+                "fused_sym_forward/b1"} <= keys
+        assert variant_fn_name("int8") == "quant_forward"
+        # conv FLOPs are precision-independent; the fused program's are
+        # the ensemble's 8x (fusion buys dispatch economics, not math)
+        q8 = led.get("quant_forward", 8)
+        f8 = led.get("fused_sym_forward", 8)
+        assert q8.flops > 0 and f8.flops > 0
+        if q8.source == "xla" and f8.source == "xla":
+            assert f8.flops > 6 * q8.flops
+
+    def test_dispatch_seconds_engine_filter(self):
+        from deepgo_tpu.obs import costmodel
+
+        snap = {"deepgo_serving_dispatch_seconds": {"series": {
+            "engine=a,bucket=8": {"sum": 2.0, "count": 2},
+            "engine=b,bucket=8": {"sum": 8.0, "count": 2},
+        }}}
+        assert costmodel.dispatch_seconds_by_bucket(snap) == {8: 2.5}
+        assert costmodel.dispatch_seconds_by_bucket(snap, engine="a") \
+            == {8: 1.0}
+        assert costmodel.dispatch_seconds_by_bucket(snap, engine="b") \
+            == {8: 4.0}
+
+
+class TestBenchGateFold:
+    def test_variant_tolerance_failure_fails_the_gate(self):
+        # --variant fold: a refused/failed variant fails the --gate
+        # verdict even when throughput itself passed
+        import json
+        import os
+        import tempfile
+
+        import bench
+
+        class Args:
+            gate = 0.10
+
+        result = {
+            "metric": "m", "value": 100.0, "device": "d",
+            "variant": {"name": "int8", "served": False,
+                        "tolerance": {"verdict": "fail"}},
+        }
+        entry = {"metric": "m", "value": 100.0, "device": "d"}
+        real = bench.LAST_GOOD_PATH
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"m": entry}, f)
+        bench.LAST_GOOD_PATH = f.name
+        try:
+            bench._apply_gate(result, Args())
+        finally:
+            bench.LAST_GOOD_PATH = real
+            os.unlink(f.name)
+        gate = result["gate"]
+        assert gate["variant_tolerance"] == "fail"
+        assert gate["verdict"] == "fail"
+        assert "int8" in gate["reason"]
+
+    def test_variant_tolerance_pass_leaves_gate_alone(self):
+        import json
+        import os
+        import tempfile
+
+        import bench
+
+        class Args:
+            gate = 0.10
+
+        result = {
+            "metric": "m", "value": 100.0, "device": "d",
+            "variant": {"name": "int8", "served": True,
+                        "tolerance": {"verdict": "pass"}},
+        }
+        entry = {"metric": "m", "value": 100.0, "device": "d"}
+        real = bench.LAST_GOOD_PATH
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"m": entry}, f)
+        bench.LAST_GOOD_PATH = f.name
+        try:
+            bench._apply_gate(result, Args())
+        finally:
+            bench.LAST_GOOD_PATH = real
+            os.unlink(f.name)
+        assert result["gate"]["verdict"] == "pass"
+        assert result["gate"]["variant_tolerance"] == "pass"
+
+
+class TestArenaVariantGate:
+    @pytest.mark.slow
+    def test_standard_gate_int8_vs_f32_champion(self):
+        # the live A/B: the int8 champion against the f32 one under the
+        # pinned arena protocol, both sides riding shared variant
+        # engines. Grid net => the quantized side plays BIT-IDENTICAL
+        # moves, so the color-balanced match cannot show a strength gap.
+        from deepgo_tpu.agents import PolicyAgent
+        from deepgo_tpu.match import standard_gate
+        from deepgo_tpu.serving import (close_shared_engines,
+                                        shared_policy_engine)
+
+        params = grid_net()
+        try:
+            e_f32 = shared_policy_engine(params, CFG, config=ECFG)
+            e_int8 = shared_policy_engine(params, CFG, config=ECFG,
+                                          variant="int8")
+            a = PolicyAgent(params, CFG, name="int8", engine=e_int8)
+            b = PolicyAgent(params, CFG, name="f32", engine=e_f32)
+            _, _, stats = standard_gate(a, b, n_games=4, max_moves=24)
+            assert stats["games"] == 4
+            assert stats["protocol"]["opening_plies"] == 8
+            # bit-identical policies + color-swapped shared openings:
+            # every decided pair splits, so A cannot lose the gate
+            assert 0.0 <= stats["win_rate_a"] <= 1.0
+            assert stats["int8_wins"] + stats["f32_wins"] \
+                + stats["draws"] == 4
+        finally:
+            close_shared_engines()
