@@ -3,7 +3,7 @@
 .PHONY: native data test test-full lint verify verify-faults verify-serving \
     verify-resilience verify-fleet verify-distributed verify-obs \
     verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
-    verify-cost verify-quant bench bench-gate smoke clean
+    verify-cost verify-quant verify-telemetry bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -66,7 +66,10 @@ verify-cost:  # device cost ledger: analytic-vs-XLA cross-check, ladder monotoni
 verify-quant:  # int8 + fused-sym serving variants: po2 bitwise identity, per-rung tolerance floors, mixed-variant fleet zero-recompile, hot-swap old-or-new proof, refusal path
 	JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant  # the full failure-model suite
+verify-telemetry:  # fleet telemetry plane: fake-clock sampler cadence, retention/downsample pinning, anomaly matrix, dead-endpoint federation, dash --once/--json, trend
+	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry  # the full failure-model suite
 
 bench:
 	python bench.py

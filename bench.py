@@ -892,7 +892,11 @@ def _bench_loop(on_tpu: bool, faults_spec: str | None = None) -> dict:
         segment_games=3, gate_games=4, gate_threshold=0.0,
         windows=windows, stall_timeout_s=300.0,
         max_component_restarts=8,
-        replica_max_restarts=0 if faults_spec else None)
+        replica_max_restarts=0 if faults_spec else None,
+        # the chaos soak doubles as the telemetry acceptance run: the
+        # sampler + anomaly watchlist ride the loop, and the component
+        # kills must surface as typed anomaly events in loop.jsonl
+        telemetry=bool(faults_spec), telemetry_interval_s=0.2)
     lcfg = ExperimentConfig(name="loop-bench", num_layers=2, channels=8,
                             batch_size=8, rate=0.05)
     tmp = tempfile.mkdtemp(prefix="deepgo-loop-bench-")
@@ -940,6 +944,8 @@ def _bench_loop(on_tpu: bool, faults_spec: str | None = None) -> dict:
             "fleet_reloads": summary["fleet_reloads"],
             "seconds": round(dt, 2),
         }
+        if summary.get("anomalies") is not None:
+            result["anomalies"] = summary["anomalies"]
         from deepgo_tpu.analysis import lockcheck, xlacheck
 
         if lockcheck.enabled():
@@ -979,6 +985,8 @@ def _bench_loop(on_tpu: bool, faults_spec: str | None = None) -> dict:
                 f"trained (fatal: {summary['fatal']})")
         if not result["champion_newer"]:
             errors.append("served champion never advanced past the seed")
+        if faults_spec and not result.get("anomalies", {}).get("count"):
+            errors.append("chaos kills produced no telemetry anomaly")
         if errors:
             result["error"] = "; ".join(errors)
         return result
@@ -999,48 +1007,39 @@ def _attach_obs(result: dict, exporter) -> None:
     exporter.close()
 
 
-def _tracing_ab(forward, params, ecfg, tracing_mod,
-                submitters: int = 4, per_thread: int = 48) -> dict:
-    """The tracing overhead A/B: identical concurrent-submitter bursts
-    through fresh engines over the SAME warm jitted forward, tracing off
-    vs on, three bursts per arm interleaved with the best rate kept per
-    arm (scheduler noise hits both arms; the best-of comparison isolates
-    the instrumentation cost). The budget is <2% boards/sec."""
+def _ab_burst(forward, params, ecfg, tag: str, submitters: int,
+              per_thread: int, data: tuple) -> float:
+    """One A/B arm burst: a fresh engine over the SAME warm jitted
+    forward, ``submitters`` threads pushing ``per_thread`` single-board
+    requests each; returns boards/sec. Shared by the tracing and
+    telemetry overhead A/Bs so the two comparisons cannot diverge in
+    methodology."""
     import threading
 
     from deepgo_tpu.serving import InferenceEngine
 
-    rng = np.random.default_rng(7)
-    packed, player, rank = _rand_batch(rng, (submitters,))
-    boards = submitters * per_thread
+    packed, player, rank = data
+    eng = InferenceEngine(forward, params, ecfg, name=f"ab-{tag}")
+    eng.warmup()
 
-    def burst(tag: str) -> float:
-        eng = InferenceEngine(forward, params, ecfg, name=f"ab-{tag}")
-        eng.warmup()
+    def submitter(i: int) -> None:
+        for _ in range(per_thread):
+            eng.submit(packed[i], int(player[i]), int(rank[i])).result()
 
-        def submitter(i: int) -> None:
-            for _ in range(per_thread):
-                eng.submit(packed[i], int(player[i]), int(rank[i])).result()
+    threads = [threading.Thread(target=submitter, args=(i,),
+                                name=f"bench-ab-{tag}-{i}")
+               for i in range(submitters)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.time() - t0
+    eng.close()
+    return submitters * per_thread / dt
 
-        threads = [threading.Thread(target=submitter, args=(i,),
-                                    name=f"bench-ab-{tag}-{i}")
-                   for i in range(submitters)]
-        t0 = time.time()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        dt = time.time() - t0
-        eng.close()
-        return boards / dt
 
-    rates = {"off": 0.0, "on": 0.0}
-    for i in range(3):
-        tracing_mod.disable_tracing()
-        rates["off"] = max(rates["off"], burst(f"off{i}"))
-        tracing_mod.configure_tracing(sink=None)
-        rates["on"] = max(rates["on"], burst(f"on{i}"))
-    tracing_mod.disable_tracing()
+def _ab_block(rates: dict, boards: int) -> dict:
     overhead = (rates["off"] - rates["on"]) / rates["off"]
     return {
         "boards_per_burst": boards,
@@ -1049,6 +1048,72 @@ def _tracing_ab(forward, params, ecfg, tracing_mod,
         "overhead_frac": round(overhead, 4),
         "ok": overhead < 0.02,
     }
+
+
+def _tracing_ab(forward, params, ecfg, tracing_mod,
+                submitters: int = 4, per_thread: int = 48) -> dict:
+    """The tracing overhead A/B: identical concurrent-submitter bursts
+    through fresh engines over the SAME warm jitted forward, tracing off
+    vs on, three bursts per arm interleaved with the best rate kept per
+    arm (scheduler noise hits both arms; the best-of comparison isolates
+    the instrumentation cost). The budget is <2% boards/sec."""
+    rng = np.random.default_rng(7)
+    data = _rand_batch(rng, (submitters,))
+
+    rates = {"off": 0.0, "on": 0.0}
+    for i in range(3):
+        tracing_mod.disable_tracing()
+        rates["off"] = max(rates["off"],
+                           _ab_burst(forward, params, ecfg, f"off{i}",
+                                     submitters, per_thread, data))
+        tracing_mod.configure_tracing(sink=None)
+        rates["on"] = max(rates["on"],
+                          _ab_burst(forward, params, ecfg, f"on{i}",
+                                    submitters, per_thread, data))
+    tracing_mod.disable_tracing()
+    return _ab_block(rates, submitters * per_thread)
+
+
+def _telemetry_ab(forward, params, ecfg,
+                  submitters: int = 4, per_thread: int = 48) -> dict:
+    """The telemetry overhead A/B (same methodology as ``_tracing_ab``):
+    sampler + anomaly detector off vs armed at the bench's own 100 ms
+    cadence over a throwaway store, best-of-3 interleaved per arm. The
+    telemetry plane touches no request path — its cost is the sampler
+    thread's registry snapshots — so the budget is the same <2%."""
+    import shutil
+    import tempfile
+
+    from deepgo_tpu.obs import anomaly as anomaly_mod
+    from deepgo_tpu.obs import timeseries as ts_mod
+
+    rng = np.random.default_rng(13)
+    data = _rand_batch(rng, (submitters,))
+    tmp = tempfile.mkdtemp(prefix="deepgo-ts-ab-")
+    rates = {"off": 0.0, "on": 0.0}
+    try:
+        for i in range(3):
+            rates["off"] = max(rates["off"],
+                               _ab_burst(forward, params, ecfg,
+                                         f"tsoff{i}", submitters,
+                                         per_thread, data))
+            store = ts_mod.TimeSeriesStore(os.path.join(tmp, str(i)))
+            det = anomaly_mod.AnomalyDetector(store=store, flight=False)
+            sampler = ts_mod.TelemetrySampler(
+                store, interval_s=0.1, listeners=[det.observe],
+                flight_tick=False)
+            sampler.start()
+            try:
+                rates["on"] = max(rates["on"],
+                                  _ab_burst(forward, params, ecfg,
+                                            f"tson{i}", submitters,
+                                            per_thread, data))
+            finally:
+                sampler.stop()
+                store.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return _ab_block(rates, submitters * per_thread)
 
 
 def _grid_decisive_params(cfg, params, seed: int = 0, sharp: float = 4.0):
@@ -1277,6 +1342,35 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     trace_sink = (None if os.environ.get("DEEPGO_FLIGHT") == "0"
                   else JsonlSink(trace_path))
     trace_rec = tracing_mod.configure_tracing(sink=trace_sink)
+    # the fleet telemetry plane rides every serving bench run
+    # (obs/timeseries.py + obs/anomaly.py): a background sampler appends
+    # the registry to <flight-dir>/ts/ts-NNNN.jsonl at 100ms and the
+    # streaming watchlist runs over the stream. The acceptance facts are
+    # measured, not asserted: a chaos kill MUST surface as a typed
+    # anomaly within one sample window of the failure counter moving,
+    # and a clean run MUST stay silent — both land in the JSON as
+    # `anomalies` (count / by_kind / first_detect_s), and a violation in
+    # either direction is an error.
+    from deepgo_tpu.obs import anomaly as anomaly_mod
+    from deepgo_tpu.obs import timeseries as ts_mod
+
+    # DEEPGO_FLIGHT=0 is the no-artifacts-in-cwd switch (same contract
+    # as the flight recorder and the trace sink): telemetry stays armed
+    # — the anomaly verdict must still land in the JSON — but the chunk
+    # store lives in a self-cleaning tempdir instead of the checkout
+    ts_tmp = None
+    if os.environ.get("DEEPGO_FLIGHT") == "0":
+        import tempfile
+
+        ts_tmp = tempfile.mkdtemp(prefix="deepgo-bench-ts-")
+        ts_dir = ts_tmp
+    else:
+        ts_dir = os.path.join(trace_dir, "ts")
+    ts_store = ts_mod.TimeSeriesStore(ts_dir)
+    detector = anomaly_mod.AnomalyDetector(sink=trace_sink, store=ts_store)
+    sampler = ts_mod.TelemetrySampler(ts_store, interval_s=0.1,
+                                      listeners=[detector.observe])
+    ts_mod.set_live_store(ts_store)
     if faults_spec:
         from deepgo_tpu.utils import faults as faults_mod
 
@@ -1420,6 +1514,7 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         reload_thread = threading.Thread(target=reloader,
                                          name="bench-reloader", daemon=True)
 
+    sampler.start()
     t0 = time.time()
     threads = [threading.Thread(target=submitter, args=(i,),
                                 name=f"bench-submitter-{i}")
@@ -1433,6 +1528,11 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     if reload_thread is not None:
         reload_thread.join(timeout=60)
     dt = time.time() - t0
+    # the telemetry window closes WITH the workload: the post-run
+    # teardown (throughput falling to zero, engines closing) is not an
+    # anomaly and must not be sampled as one
+    sampler.stop()
+    ts_store.close()
     stats = engine.stats()
     health = engine.health() if (faults_spec or fleet) else None
     if slo_tracker is not None:
@@ -1509,6 +1609,24 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
 
         faults_mod.reset()  # the chaos plan must not bleed into the A/B
     tracing_block["ab"] = _tracing_ab(forward, params, ecfg, tracing_mod)
+    # the telemetry anomaly contract, measured both ways: chaos faults
+    # must be detected (the kill's failure counters fire the no-warmup
+    # rate watches on the next 100ms sample), a clean run must be silent
+    anomalies_block = detector.summary(t0)
+    anomalies_block["samples"] = sampler.samples_taken
+    if ts_tmp is None:
+        anomalies_block["store_dir"] = ts_store.dir
+    else:
+        import shutil
+
+        shutil.rmtree(ts_tmp, ignore_errors=True)
+    if faults_spec and detector.count == 0:
+        errors.append("chaos faults produced no telemetry anomaly "
+                      "(detector missed the kill)")
+    if not faults_spec and detector.count:
+        errors.append(f"{detector.count} telemetry anomalies on a clean "
+                      "run (detector must stay silent)")
+    anomalies_block["ab"] = _telemetry_ab(forward, params, ecfg)
     if trace_sink is not None:
         trace_sink.close()
     if fleet:
@@ -1582,6 +1700,7 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
         if xlacheck_report is not None:
             result["xlacheck"] = xlacheck_report
     result["tracing"] = tracing_block
+    result["anomalies"] = anomalies_block
     if vspec is not None:
         result["variant"] = _variant_ab(variant, vspec, forward, params,
                                         cfg, ecfg, buckets, cost_ledger)

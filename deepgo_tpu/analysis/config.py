@@ -106,9 +106,13 @@ class LintConfig:
     # the first entry MUST stay "deepgo_" (the metric namespace — the
     # rest are JSONL event-kind namespaces). trace_* (request exemplars)
     # and lineage_* (the loop provenance chain) joined in ISSUE 10;
-    # cost_* (the AOT device cost ledger) in ISSUE 12.
+    # cost_* (the AOT device cost ledger) in ISSUE 12; ts_* and
+    # anomaly_* (the fleet telemetry plane: sample/scrape-failure
+    # events; the `anomaly` event itself is prefix-free by name and
+    # documented next to them) in ISSUE 14.
     grammar_prefixes: tuple = ("deepgo_", "obs_", "loop_", "fleet_",
-                               "trace_", "lineage_", "cost_")
+                               "trace_", "lineage_", "cost_", "ts_",
+                               "anomaly_")
     # doc tokens that share a grammar prefix but are not metrics/events:
     # bench JSON keys and similar
     grammar_ignore: frozenset = frozenset({

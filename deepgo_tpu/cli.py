@@ -19,6 +19,16 @@
   python -m deepgo_tpu.cli obs         offline observability report: join a
                                        run's metrics/trace/elastic JSONL
                                        streams into one per-stage table
+  python -m deepgo_tpu.cli dash        live operator dashboard: watchlist
+                                       sparklines, fleet health grid,
+                                       active anomalies, SLO burn state —
+                                       over a run directory's time-series
+                                       store or N scraped /metrics
+                                       endpoints federated into one view
+  python -m deepgo_tpu.cli trend       bench trajectory: every committed
+                                       BENCH_r*.json round joined with
+                                       BENCH_LAST_GOOD.json into one
+                                       per-metric history table
   python -m deepgo_tpu.cli trace       reconstruct one request's waterfall
                                        (from sampled trace_request
                                        exemplars) or a champion's lineage
@@ -250,6 +260,27 @@ def cmd_serve(args) -> None:
               "checkpoint)", flush=True)
     exporter = start_exporter(args.obs_port)
     exporter.add_health("fleet", health_from_engine(fleet))
+    sampler = telem_sink = None
+    if args.telemetry_dir:
+        # the fleet telemetry plane on the daemon (docs/observability.md
+        # "Fleet telemetry plane"): registry history + streaming anomaly
+        # watchlist into --telemetry-dir; `cli dash DIR` renders it live
+        # and the exporter's /series serves the recent window
+        from .obs import (AnomalyDetector, JsonlSink, TelemetrySampler,
+                          TimeSeriesStore, set_live_store)
+
+        ts_store = TimeSeriesStore(args.telemetry_dir)
+        telem_sink = JsonlSink(os.path.join(args.telemetry_dir,
+                                            "metrics.jsonl"))
+        detector = AnomalyDetector(sink=telem_sink, store=ts_store)
+        sampler = TelemetrySampler(ts_store,
+                                   interval_s=args.telemetry_interval,
+                                   listeners=[detector.observe])
+        set_live_store(ts_store)
+        sampler.start()
+        print(f"serve: telemetry -> {args.telemetry_dir} "
+              f"(ts-NNNN.jsonl every {args.telemetry_interval:g}s; "
+              "`cli dash` it)", flush=True)
     print(f"serve: fleet of {args.fleet} replica(s) over {source} "
           f"({warmed} warm shapes/replica); /healthz composes the fleet "
           "verdict", flush=True)
@@ -279,6 +310,11 @@ def cmd_serve(args) -> None:
                               "futures, zero recompiles)", flush=True)
     finally:
         health = fleet.health()
+        if sampler is not None:
+            sampler.stop(final_sample=True)
+            sampler.store.close()
+        if telem_sink is not None:
+            telem_sink.close()
         exporter.close()
         fleet.close()
         print(f"serve: done ({health['replicas_serving']}/"
@@ -301,6 +337,8 @@ def cmd_loop(args) -> None:
 
     config = LoopConfig(
         trace=args.trace,
+        telemetry=args.telemetry,
+        telemetry_interval_s=args.telemetry_interval,
         actors=args.actors,
         fleet=args.fleet,
         games_per_round=args.games_per_round,
@@ -354,6 +392,66 @@ def cmd_obs(args) -> None:
         print(_json.dumps(summary, indent=1, default=str))
     else:
         print(format_report(summary))
+
+
+def cmd_dash(args) -> None:
+    """The live operator dashboard (obs/dash.py, docs/observability.md
+    "Fleet telemetry plane"): one terminal frame of watchlist
+    sparklines, the per-host/per-replica fleet health grid, the anomaly
+    tail, and SLO burn state — refreshed in place until interrupted,
+    or rendered once for CI with ``--once`` / ``--json``."""
+    import json as _json
+    import time as _time
+
+    from .obs import dash as dash_mod
+
+    urls = {}
+    for i, u in enumerate(p.strip()
+                          for p in (args.scrape or "").split(",")):
+        if u:
+            # host label: the URL's host:port (stable + readable), not
+            # the list index — the same endpoint keeps the same label
+            # across invocations
+            urls[u.split("//")[-1].rstrip("/") or f"host{i}"] = u
+    if not args.run_dir and not urls:
+        raise SystemExit("dash needs RUN_DIR or --scrape URL[,URL...]")
+    history = dash_mod.DashHistory(window=args.window) if urls else None
+    once = args.once or args.json
+    try:
+        while True:
+            data = dash_mod.collect_dash(
+                args.run_dir or None, urls or None, history=history,
+                window=args.window)
+            if args.json:
+                print(_json.dumps(data, indent=1, default=str))
+            else:
+                frame = dash_mod.render_dash(data)
+                if not once:
+                    # clear + home: redraw in place, no scrollback spam
+                    print("\x1b[2J\x1b[H", end="")
+                print(frame, flush=True)
+            if once:
+                return
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return
+    except BrokenPipeError:
+        return  # `cli dash ... | head` is legitimate operator usage
+
+
+def cmd_trend(args) -> None:
+    """The bench trajectory table (obs/dash.py): BENCH_r*.json rounds
+    + BENCH_LAST_GOOD.json, per metric, stale captures marked — the
+    history the regression gate's verdicts come from."""
+    import json as _json
+
+    from .obs import dash as dash_mod
+
+    data = dash_mod.collect_trend(args.root)
+    if args.json:
+        print(_json.dumps(data, indent=1, default=str))
+    else:
+        print(dash_mod.render_trend(data))
 
 
 def cmd_trace(args) -> None:
@@ -584,6 +682,16 @@ def main(argv=None) -> None:
                         "loop")
     p.add_argument("--watch-interval", type=float, default=5.0, metavar="S",
                    help="checkpoint poll cadence (default 5s)")
+    p.add_argument("--telemetry-dir", metavar="DIR",
+                   help="arm the fleet telemetry plane: append the "
+                        "registry to DIR/ts-NNNN.jsonl on a fixed "
+                        "cadence and run the streaming anomaly "
+                        "watchlist over it (anomaly events -> "
+                        "DIR/metrics.jsonl; `cli dash DIR` renders it "
+                        "live — docs/observability.md)")
+    p.add_argument("--telemetry-interval", type=float, default=1.0,
+                   metavar="S",
+                   help="telemetry sampling cadence (default 1s)")
     p.add_argument("--duration", type=float, default=0.0, metavar="S",
                    help="serve for S seconds then exit (0 = until "
                         "SIGINT/SIGTERM)")
@@ -660,6 +768,17 @@ def main(argv=None) -> None:
                         "sampling streamed to <run-dir>/trace.jsonl — "
                         "`cli trace RUN_DIR ID` renders the waterfalls "
                         "(docs/observability.md)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="arm the fleet telemetry plane: a background "
+                        "sampler appends the registry to "
+                        "<run-dir>/ts-NNNN.jsonl (retention-bounded, "
+                        "power-of-two downsampled) and the streaming "
+                        "anomaly watchlist runs over it — `cli dash "
+                        "RUN_DIR` renders the history live "
+                        "(docs/observability.md)")
+    p.add_argument("--telemetry-interval", type=float, default=1.0,
+                   metavar="S",
+                   help="telemetry sampling cadence (default 1s)")
     p.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                    help="live /metrics + /healthz (fleet + loop "
                         "progress) for the duration of the run")
@@ -728,6 +847,43 @@ def main(argv=None) -> None:
     p.add_argument("--json", action="store_true",
                    help="emit the summary as JSON instead of the table")
     p.set_defaults(fn=cmd_obs)
+
+    p = sub.add_parser("dash", help="live operator dashboard: watchlist "
+                                    "sparklines, fleet health grid, "
+                                    "anomalies, SLO burn — over a run "
+                                    "dir's time-series store or scraped "
+                                    "/metrics endpoints "
+                                    "(docs/observability.md)")
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="run directory holding ts-NNNN.jsonl chunks "
+                        "(written by a --telemetry loop run or a bench "
+                        "run); omit with --scrape")
+    p.add_argument("--scrape", metavar="URL[,URL...]",
+                   help="federate these live /metrics endpoints instead "
+                        "of reading a store (fleet replicas, elastic "
+                        "hosts); each gets a host label, dead endpoints "
+                        "are tolerated and flagged")
+    p.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="refresh cadence (default 2s)")
+    p.add_argument("--window", type=int, default=240, metavar="N",
+                   help="samples per sparkline window (default 240)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (CI)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the frame's underlying dict once as JSON "
+                        "(implies --once; schema in "
+                        "docs/observability.md)")
+    p.set_defaults(fn=cmd_dash)
+
+    p = sub.add_parser("trend", help="bench trajectory: BENCH_r*.json "
+                                     "rounds + BENCH_LAST_GOOD.json as "
+                                     "one per-metric history table "
+                                     "(stale captures marked)")
+    p.add_argument("--root", default=".",
+                   help="repo root holding the BENCH_r*.json artifacts")
+    p.add_argument("--json", action="store_true",
+                   help="emit the joined history as JSON")
+    p.set_defaults(fn=cmd_trend)
 
     # "selfplay" is forwarded before parsing (above); listed here so it
     # shows up in --help output

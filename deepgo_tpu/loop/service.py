@@ -110,6 +110,13 @@ class LoopConfig:
     # over the fleet's serving path, streaming trace_request records to
     # <run_dir>/trace.jsonl so `cli trace` can render waterfalls offline
     trace: bool = False
+    # the fleet telemetry plane (obs/timeseries.py + obs/anomaly.py):
+    # sample the registry into <run_dir>/ts-NNNN.jsonl on this cadence
+    # and stream the anomaly watchlist over it — anomaly events land in
+    # loop.jsonl, pin their series window in the store, and trip the
+    # flight recorder; `cli dash RUN_DIR` renders the history live
+    telemetry: bool = False
+    telemetry_interval_s: float = 1.0
 
 
 class ExpertIterationLoop:
@@ -139,6 +146,19 @@ class ExpertIterationLoop:
             self._trace_sink = JsonlSink(os.path.join(run_dir,
                                                       "trace.jsonl"))
             configure_tracing(sink=self._trace_sink)
+        self._sampler = None
+        self._detector = None
+        if self.config.telemetry:
+            from ..obs import (AnomalyDetector, TelemetrySampler,
+                               TimeSeriesStore, set_live_store)
+
+            ts_store = TimeSeriesStore(run_dir)
+            self._detector = AnomalyDetector(sink=self.metrics,
+                                             store=ts_store)
+            self._sampler = TelemetrySampler(
+                ts_store, interval_s=self.config.telemetry_interval_s,
+                listeners=[self._detector.observe])
+            set_live_store(ts_store)
         self._stop = threading.Event()
         self._learner_done = threading.Event()
         self._gate_queue: queue.Queue = queue.Queue()
@@ -365,6 +385,8 @@ class ExpertIterationLoop:
             args=("gatekeeper", self._gatekeeper_body),
             name="loop-gatekeeper", daemon=True))
         t0 = time.monotonic()
+        if self._sampler is not None:
+            self._sampler.start()
         for t in threads:
             t.start()
         try:
@@ -394,6 +416,11 @@ class ExpertIterationLoop:
             self._learner_done.set()
             for t in threads:
                 t.join(timeout=30)
+            if self._sampler is not None:
+                # one final sample after the threads are down: the
+                # close-time state rides in the history like obs_snapshot
+                self._sampler.stop(final_sample=True)
+                self._sampler.store.close()
             summary = self.summary()
             summary["seconds"] = round(time.monotonic() - t0, 3)
             if self._trace_sink is not None:
@@ -439,6 +466,8 @@ class ExpertIterationLoop:
             "fleet_reloads": fleet_stats["reloads"],
             "buffer": self.buffer.stats(),
             "fatal": dict(self.fatal),
+            **({"anomalies": self._detector.summary()}
+               if self._detector is not None else {}),
         }
 
 

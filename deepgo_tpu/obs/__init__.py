@@ -37,7 +37,16 @@ plus the analysis-and-enforcement layer on top (ISSUE 6):
     serving path (queued/routed/coalesced/dispatched/resolved + failover
     hops, one trace id surviving restarts), bounded-memory tail-exemplar
     sampling folded into flight-recorder dumps, and the loop's
-    ``lineage_*`` provenance chain (``cli trace RUN_DIR ID``).
+    ``lineage_*`` provenance chain (``cli trace RUN_DIR ID``);
+  * ``timeseries`` / ``federate`` / ``anomaly`` / ``dash`` — the fleet
+    telemetry plane (ISSUE 14): a background sampler appending the
+    registry to a retention-bounded, power-of-two-downsampled on-disk
+    time-series store (``ts-NNNN.jsonl``), cross-host federation of
+    live scrapes and offline stores into one host-labeled view (a dead
+    endpoint is a ``ts_scrape_failed`` event, never a crash), streaming
+    robust anomaly detection (EWMA+MAD z-score, drift, rate) over a
+    declared watchlist feeding the flight recorder, and the
+    ``cli dash`` / ``cli trend`` operator surfaces.
 
 Finding scaling bottlenecks is a measurement problem first (FireCaffe,
 arXiv:1511.00175; arXiv:1711.00705): every future perf claim in this
@@ -69,3 +78,10 @@ from .costmodel import (CostEntry, CostLedger, PlatformPeak,  # noqa: F401
                         dispatch_seconds_by_bucket, evaluate_mfu_floor,
                         format_ledger, get_cost_ledger, set_cost_ledger,
                         standard_ledger)
+from .timeseries import (TelemetrySampler, TimeSeriesStore,  # noqa: F401
+                         flatten_snapshot, get_live_store, load_samples,
+                         series_from_samples, set_live_store)
+from .anomaly import (DEFAULT_WATCHLIST, Anomaly,  # noqa: F401
+                      AnomalyDetector, WatchSpec)
+from .federate import (FederatedView, parse_prometheus,  # noqa: F401
+                       scrape_series, store_series, with_labels)

@@ -18,7 +18,9 @@ and files — nothing to ``pip install`` on the container):
     into one JSON verdict — HTTP 200 when every component is healthy,
     503 the moment one is not, so a kill injection flips the endpoint
     within the detector's own budget — plus ``/trace`` (the live
-    tail-exemplar ring) and ``/cost`` (the AOT device cost ledger).
+    tail-exemplar ring), ``/cost`` (the AOT device cost ledger), and
+    ``/series`` (the recent window of the live time-series store,
+    obs/timeseries.py — ``?metric=NAME`` for aligned (t, value) points).
 
 Port 0 binds an ephemeral port (tests); ``exporter.port`` reports the
 real one. The server thread is a daemon and ``close()`` is idempotent —
@@ -233,6 +235,34 @@ class _Handler(BaseHTTPRequestHandler):
             payload = {"enabled": ledger is not None}
             if ledger is not None:
                 payload["ledger"] = ledger.to_dict()
+            body = (json.dumps(payload, default=str) + "\n").encode()
+            self._reply(200, body, "application/json")
+        elif path == "/series":
+            # the recent telemetry window from the live time-series
+            # store (obs/timeseries.py): ?metric=NAME[&n=POINTS] returns
+            # aligned (t, value) points per matching series key; without
+            # ?metric=, the known keys. Served from the store's
+            # in-memory tail — a scrape never touches the chunk files.
+            from urllib.parse import parse_qs
+
+            from .timeseries import get_live_store
+
+            qs = parse_qs(self.path.partition("?")[2])
+            store = get_live_store()
+            payload = {"enabled": store is not None}
+            if store is not None:
+                metric = qs.get("metric", [None])[0]
+                try:
+                    n = max(1, int(qs.get("n", ["240"])[0]))
+                except ValueError:
+                    n = 240
+                if metric:
+                    payload["metric"] = metric
+                    payload["series"] = store.recent_series(metric, n)
+                else:
+                    payload["keys"] = sorted(
+                        {k for rec in store.recent_window(n)
+                         for k in (rec.get("values") or {})})
             body = (json.dumps(payload, default=str) + "\n").encode()
             self._reply(200, body, "application/json")
         else:

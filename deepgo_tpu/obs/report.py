@@ -345,6 +345,66 @@ def summarize_run(run_dir: str) -> dict:
                                    "burn_fast", "burn_slow")}
             for r in burns]
 
+    # ---- telemetry time-series (the ts-NNNN.jsonl chunk store written
+    # by obs/timeseries.py): the historical view next to the point-in-
+    # time snapshot — per-watchlist series last/min/max over the whole
+    # retained window, so "when did it degrade" is answerable offline
+    from .dash import find_store_dir
+    from .timeseries import (key_field, list_keys, load_samples,
+                             series_from_samples)
+
+    ts_samples = load_samples(find_store_dir(run_dir))
+    if ts_samples:
+        from .anomaly import DEFAULT_WATCHLIST
+
+        watch: dict = {}
+        for spec in DEFAULT_WATCHLIST:
+            per_key = series_from_samples(ts_samples, spec.metric)
+            for key, points in sorted(per_key.items()):
+                if key_field(key) != spec.field:
+                    continue
+                values = [v for _, v in points]
+                watch[key] = {
+                    "points": len(points),
+                    "last": round(values[-1], 6),
+                    "min": round(min(values), 6),
+                    "max": round(max(values), 6),
+                }
+        summary["timeseries"] = {
+            "samples": len(ts_samples),
+            "series": len(list_keys(ts_samples)),
+            "span_s": round(ts_samples[-1]["t"] - ts_samples[0]["t"], 3),
+            "pinned": sum(1 for r in ts_samples if r.get("pin")),
+            "watch": watch,
+        }
+
+    # ---- anomalies (the streaming detector's typed events,
+    # obs/anomaly.py) — what fired, when, and how hard, plus the scrape
+    # failures the federation layer absorbed
+    anomalies = [r for r in metrics if r.get("kind") == "anomaly"]
+    anomalies.extend(r for r in trace_stream
+                     if r.get("kind") == "anomaly")
+    if anomalies:
+        by_kind: dict = {}
+        for r in anomalies:
+            k = str(r.get("detector", "?"))
+            by_kind[k] = by_kind.get(k, 0) + 1
+        summary["anomalies"] = {
+            "count": len(anomalies),
+            "by_kind": by_kind,
+            "events": [{k: r.get(k) for k in ("metric", "series",
+                                              "detector", "value",
+                                              "baseline", "score", "t")}
+                       for r in anomalies[-20:]],
+        }
+    scrape_failures = [r for r in metrics
+                       if r.get("kind") == "ts_scrape_failed"]
+    if scrape_failures:
+        summary["events"]["scrape_failures"] = {
+            str(r.get("host", "?")): sum(
+                1 for s in scrape_failures if s.get("host") == r.get("host"))
+            for r in scrape_failures}
+
     # ---- the AOT device cost ledger (cost_ledger events streamed by
     # obs/costmodel.py at train start / bench warmup): the per-entrypoint
     # FLOPs / bytes / HBM bill the attribution roofline divides by
@@ -432,6 +492,39 @@ def format_report(summary: dict) -> str:
         for r in rows:
             lines.append("  " + "  ".join(v.ljust(w)
                                           for v, w in zip(r, widths)))
+    ts = summary.get("timeseries")
+    if ts:
+        lines.append("")
+        lines.append(f"telemetry time-series ({ts['samples']} samples, "
+                     f"{ts['series']} series over {ts['span_s']}s, "
+                     f"{ts['pinned']} pinned — `cli dash` for sparklines):")
+        watch = ts.get("watch", {})
+        if watch:
+            cols = ["series", "points", "last", "min", "max"]
+            rows = [[key[:72], str(row["points"]), f"{row['last']:g}",
+                     f"{row['min']:g}", f"{row['max']:g}"]
+                    for key, row in watch.items()]
+            widths = [max(len(c), *(len(r[i]) for r in rows))
+                      for i, c in enumerate(cols)]
+            lines.append("  " + "  ".join(c.ljust(w)
+                                          for c, w in zip(cols, widths)))
+            for r in rows:
+                lines.append("  " + "  ".join(v.ljust(w)
+                                              for v, w in zip(r, widths)))
+    anom = summary.get("anomalies")
+    if anom:
+        lines.append("")
+        lines.append(f"anomalies ({anom['count']} total, "
+                     + ", ".join(f"{k}={v}"
+                                 for k, v in sorted(anom["by_kind"]
+                                                    .items()))
+                     + "):")
+        for e in anom["events"]:
+            lines.append(
+                f"  {e.get('detector', '?'):5s}  "
+                f"{e.get('series') or e.get('metric')}  "
+                f"value {e.get('value')} vs baseline {e.get('baseline')} "
+                f"(score {e.get('score')})")
     cost = summary.get("cost_ledger")
     if cost:
         lines.append("")

@@ -19,8 +19,10 @@ and a black box:
   * **flight recorder** — a ring buffer of the last N seconds of registry
     snapshots plus the most recent completed spans, dumped atomically as
     ``flight-NNNN.json`` when an incident trips: a supervisor engine
-    restart, an elastic ``HostLost``, an SLO fast burn, or an external
-    watchdog about to fire (the watchdog child sends SIGUSR1 one second
+    restart, an elastic ``HostLost``, an SLO fast burn, a telemetry
+    anomaly (obs/anomaly.py — those dumps additionally carry the
+    surrounding ``series_window`` section the detector registers), or an
+    external watchdog about to fire (the watchdog child sends SIGUSR1 one second
     before the SIGKILL; ``install_signal_dump`` makes that signal dump —
     best-effort, since a C-level GIL-held wedge cannot run any Python,
     signal handlers included). Disabled by default (zero overhead);
@@ -303,7 +305,8 @@ def configure_flight(dump_dir: str, **kw) -> FlightRecorder:
 def flight_dump(reason: str, **detail) -> str | None:
     """Trigger-site convenience: dump the process-wide recorder (no-op
     while unarmed). Used by the serving supervisor (engine restart), the
-    elastic loop (HostLost), and the SLO tracker (fast burn)."""
+    elastic loop (HostLost), the SLO tracker (fast burn), and the
+    telemetry anomaly detector (obs/anomaly.py)."""
     return get_flight_recorder().dump(reason, **detail)
 
 

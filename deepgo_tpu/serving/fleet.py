@@ -229,6 +229,10 @@ class FleetRouter:
         self._obs_serving = reg.gauge(
             "deepgo_fleet_replicas_serving",
             "replicas currently accepting placement")
+        self._obs_replica_state = reg.gauge(
+            "deepgo_fleet_replica_state",
+            "per-replica lifecycle: 1 serving, 0.5 draining/respawning, "
+            "0 failed — the dash health grid's rows")
         self._obs_failover_s = reg.histogram(
             "deepgo_fleet_failover_seconds",
             "first replica failure to final resolution, failed-over "
@@ -740,8 +744,17 @@ class FleetRouter:
         with self._lock:
             return sum(r.state == "serving" for r in self._replicas)
 
+    _STATE_VALUE = {"serving": 1.0, "draining": 0.5, "respawning": 0.5,
+                    "failed": 0.0}
+
     def _update_serving_gauge(self) -> None:
         self._obs_serving.set(self._serving_count(), fleet=self.name)
+        with self._lock:
+            states = [(r.idx, r.state) for r in self._replicas]
+        for idx, state in states:
+            self._obs_replica_state.set(
+                self._STATE_VALUE.get(state, 0.0),
+                fleet=self.name, replica=str(idx))
 
     def _count_shed(self, tier: str, reason: str) -> None:
         with self._lock:

@@ -1,0 +1,697 @@
+"""Fleet telemetry plane: sampler cadence, store retention, anomaly
+matrix, federation, dash, trend (ISSUE 14).
+
+The coverage contract: fake-clock sampler cadence (no sleeping),
+chunk-roll + power-of-two downsample boundaries that lose no pinned
+points, the anomaly matrix (step change fires / slow drift fires /
+noisy-but-healthy stays quiet / failure-counter increase fires with no
+warmup / a planned drain does not), federation over live exporters with
+one dead endpoint tolerated as a ``ts_scrape_failed`` event, the
+``/series`` route, ``cli dash --once``/``--json`` round-trip, ``cli
+trend`` over synthetic BENCH files of both committed shapes, and the
+``cli obs`` timeseries/anomalies sections.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from deepgo_tpu.obs import (AnomalyDetector, DEFAULT_WATCHLIST,
+                            FederatedView, JsonlSink, MetricsRegistry,
+                            ObsExporter, TelemetrySampler, TimeSeriesStore,
+                            WatchSpec, flatten_snapshot, parse_prometheus,
+                            render_prometheus, set_live_store,
+                            store_series, with_labels)
+from deepgo_tpu.obs.sentinel import FlightRecorder
+from deepgo_tpu.obs.timeseries import (chunk_paths, key_matches,
+                                       load_samples, series_from_samples,
+                                       series_key, split_key)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def make_plane(tmp_path, clock=None, watchlist=None, **det_kw):
+    """One wired (registry, store, detector, sampler) quartet over a
+    private registry and a fake clock."""
+    clock = clock or FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    store = TimeSeriesStore(str(tmp_path / "ts"), clock=clock,
+                            registry=reg)
+    det = AnomalyDetector(watchlist=watchlist, registry=reg, store=store,
+                          flight=False, clock=clock, **det_kw)
+    sampler = TelemetrySampler(store, registry=reg, interval_s=1.0,
+                               clock=clock, listeners=[det.observe],
+                               flight_tick=False)
+    return clock, reg, store, det, sampler
+
+
+# ---- keys + flattening ----
+
+
+class TestKeys:
+    def test_flatten_covers_all_kinds(self):
+        reg = MetricsRegistry()
+        reg.counter("deepgo_a_total").inc(3, engine="e")
+        reg.gauge("deepgo_b").set(2.5)
+        reg.histogram("deepgo_c_seconds").observe(0.1, engine="e")
+        values = flatten_snapshot(reg.snapshot()["metrics"])
+        assert values["deepgo_a_total{engine=e}"] == 3.0
+        assert values["deepgo_b"] == 2.5
+        assert values["deepgo_c_seconds{engine=e}:count"] == 1.0
+        assert values["deepgo_c_seconds{engine=e}:p99"] == pytest.approx(0.1)
+
+    def test_series_key_split_round_trip(self):
+        key = series_key("deepgo_x", "engine=a,tier=b", "p99")
+        assert key == "deepgo_x{engine=a,tier=b}:p99"
+        assert split_key(key) == ("deepgo_x", "engine=a,tier=b", "p99")
+        assert split_key("deepgo_x") == ("deepgo_x", "", None)
+
+    def test_key_matches_family_and_exact(self):
+        assert key_matches("deepgo_x", "deepgo_x")
+        assert key_matches("deepgo_x", "deepgo_x{engine=a}")
+        assert key_matches("deepgo_x", "deepgo_x{engine=a}:p99")
+        assert not key_matches("deepgo_x", "deepgo_xy{engine=a}")
+
+
+# ---- sampler cadence (fake clock, no sleeping) ----
+
+
+class TestSamplerCadence:
+    def test_fixed_rate_cadence(self, tmp_path):
+        clock, _reg, _store, _det, sampler = make_plane(tmp_path)
+        took = sum(sampler.maybe_sample() for _ in range(1))
+        for _ in range(40):  # 10s of quarter-second polls
+            clock.advance(0.25)
+            took += sampler.maybe_sample()
+        # first sample + one per full second elapsed
+        assert took == 1 + 10
+        assert sampler.samples_taken == took
+
+    def test_stall_skips_forward_no_burst(self, tmp_path):
+        clock, _reg, _store, _det, sampler = make_plane(tmp_path)
+        sampler.maybe_sample()
+        clock.advance(7.3)  # a long stall misses ~7 ticks
+        assert sampler.maybe_sample() is True
+        assert sampler.maybe_sample() is False  # no backfill burst
+        clock.advance(1.0)
+        assert sampler.maybe_sample() is True
+
+    def test_samples_counter_and_listener_isolation(self, tmp_path):
+        clock, reg, store, _det, sampler = make_plane(tmp_path)
+        boom = []
+
+        def bad_listener(t, values):
+            boom.append(t)
+            raise RuntimeError("listener crash")
+
+        sampler.add_listener(bad_listener)
+        sampler.sample_once()
+        clock.advance(1.0)
+        sampler.sample_once()  # the bad listener must not kill sampling
+        assert len(boom) == 2
+        assert reg.counter("deepgo_ts_samples_total").value() == 2.0
+        assert len(store.samples()) == 2
+
+    def test_background_thread_lifecycle(self, tmp_path):
+        store = TimeSeriesStore(str(tmp_path / "ts"),
+                                registry=MetricsRegistry())
+        sampler = TelemetrySampler(store, registry=MetricsRegistry(),
+                                   interval_s=0.01, flight_tick=False)
+        with sampler:
+            deadline = 200
+            while sampler.samples_taken < 3 and deadline:
+                deadline -= 1
+                import time as _t
+                _t.sleep(0.01)
+        assert sampler.samples_taken >= 3
+        sampler.stop()  # idempotent
+
+
+# ---- store: chunking, retention, downsampling, torn lines ----
+
+
+class TestStore:
+    def test_chunks_roll_at_sample_count(self, tmp_path):
+        clock = FakeClock()
+        store = TimeSeriesStore(str(tmp_path), chunk_samples=4,
+                                max_chunks=100, clock=clock,
+                                registry=MetricsRegistry())
+        for i in range(10):
+            store.append({"deepgo_x": float(i)}, t=clock.advance(1.0))
+        assert len(chunk_paths(str(tmp_path))) == 3
+        points = store.series("deepgo_x")["deepgo_x"]
+        assert [v for _, v in points] == [float(i) for i in range(10)]
+
+    def test_retention_bounds_chunks_and_halves_resolution(self, tmp_path):
+        clock = FakeClock()
+        store = TimeSeriesStore(str(tmp_path), chunk_samples=8,
+                                max_chunks=3, clock=clock,
+                                registry=MetricsRegistry())
+        n = 200
+        for i in range(n):
+            store.append({"deepgo_x": float(i)}, t=clock.advance(1.0))
+        chunks = chunk_paths(str(tmp_path))
+        assert len(chunks) <= 4  # budget + the just-opened chunk
+        points = store.series("deepgo_x")["deepgo_x"]
+        assert 0 < len(points) < n  # decimated, not truncated to nothing
+        ts = [t for t, _ in points]
+        assert ts == sorted(ts)
+        # the newest chunk keeps full resolution: the last samples survive
+        assert points[-1][1] == float(n - 1)
+        # old history survives at reduced resolution (not dropped outright)
+        assert points[0][1] < n / 4
+
+    def test_pinned_points_survive_downsampling(self, tmp_path):
+        clock = FakeClock()
+        store = TimeSeriesStore(str(tmp_path), chunk_samples=8,
+                                max_chunks=2, clock=clock,
+                                registry=MetricsRegistry())
+        pinned_ts = []
+        for i in range(120):
+            t = clock.advance(1.0)
+            pin = i % 17 == 0
+            store.append({"deepgo_x": float(i)}, t=t, pin=pin)
+            if pin:
+                pinned_ts.append(t)
+        kept = {t for t, _ in store.series("deepgo_x")["deepgo_x"]}
+        assert set(pinned_ts) <= kept
+
+    def test_pin_recent_marks_live_tail(self, tmp_path):
+        clock = FakeClock()
+        store = TimeSeriesStore(str(tmp_path), chunk_samples=4,
+                                max_chunks=2, clock=clock,
+                                registry=MetricsRegistry())
+        tail_ts = []
+        for i in range(40):
+            t = clock.advance(1.0)
+            store.append({"deepgo_x": float(i)}, t=t)
+            if i < 6:
+                tail_ts.append(t)
+            if i == 5:
+                assert store.pin_recent(6) == 6
+        # keep decimating well past the pinned region
+        for i in range(200):
+            store.append({"deepgo_x": 0.0}, t=clock.advance(1.0))
+        kept = {t for t, _ in store.series("deepgo_x")["deepgo_x"]}
+        assert set(tail_ts) <= kept
+
+    def test_torn_line_tolerance(self, tmp_path):
+        clock = FakeClock()
+        store = TimeSeriesStore(str(tmp_path), clock=clock,
+                                registry=MetricsRegistry())
+        for i in range(3):
+            store.append({"deepgo_x": float(i)}, t=clock.advance(1.0))
+        store.close()
+        path = chunk_paths(str(tmp_path))[-1]
+        with open(path, "a") as f:
+            f.write('{"kind": "ts_sample", "t": 99, "values": {"deepgo_x')
+        points = load_samples(str(tmp_path))
+        assert len(points) == 3  # the torn line is skipped, not fatal
+
+    def test_reopen_resumes_numbering(self, tmp_path):
+        clock = FakeClock()
+        store = TimeSeriesStore(str(tmp_path), chunk_samples=2,
+                                clock=clock, registry=MetricsRegistry())
+        for i in range(5):
+            store.append({"deepgo_x": float(i)}, t=clock.advance(1.0))
+        store.close()
+        store2 = TimeSeriesStore(str(tmp_path), chunk_samples=2,
+                                 clock=clock, registry=MetricsRegistry())
+        store2.append({"deepgo_x": 5.0}, t=clock.advance(1.0))
+        store2.close()
+        assert len(load_samples(str(tmp_path))) == 6
+
+    def test_recent_series_window(self, tmp_path):
+        clock = FakeClock()
+        store = TimeSeriesStore(str(tmp_path), clock=clock,
+                                registry=MetricsRegistry())
+        for i in range(10):
+            store.append({"deepgo_x": float(i)}, t=clock.advance(1.0))
+        recent = store.recent_series("deepgo_x", 4)["deepgo_x"]
+        assert [v for _, v in recent] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_bad_config_typed(self, tmp_path):
+        with pytest.raises(ValueError):
+            TimeSeriesStore(str(tmp_path), chunk_samples=1,
+                            registry=MetricsRegistry())
+        with pytest.raises(ValueError):
+            TelemetrySampler(
+                TimeSeriesStore(str(tmp_path), registry=MetricsRegistry()),
+                registry=MetricsRegistry(), interval_s=0.0)
+
+
+# ---- the anomaly matrix ----
+
+
+def drive(sampler, clock, setter, values):
+    for v in values:
+        setter(v)
+        clock.advance(1.0)
+        sampler.sample_once()
+
+
+class TestAnomalyMatrix:
+    def test_step_change_fires(self, tmp_path):
+        clock, reg, _store, det, sampler = make_plane(
+            tmp_path, watchlist=(WatchSpec("deepgo_train_samples_per_sec"),))
+        g = reg.gauge("deepgo_train_samples_per_sec")
+        rnd = random.Random(0)
+        drive(sampler, clock, g.set,
+              [1000 + rnd.gauss(0, 5) for _ in range(40)])
+        assert det.count == 0
+        drive(sampler, clock, g.set, [400.0])  # the step
+        assert det.count == 1
+        a = det.anomalies[-1]
+        assert a.kind == "step"
+        assert a.metric == "deepgo_train_samples_per_sec"
+        # hysteresis: the same incident does not re-fire every sample
+        drive(sampler, clock, g.set, [400.0] * 5)
+        assert det.count == 1
+
+    def test_noisy_but_healthy_stays_quiet(self, tmp_path):
+        clock, reg, _store, det, sampler = make_plane(
+            tmp_path, watchlist=(WatchSpec("deepgo_train_samples_per_sec"),))
+        g = reg.gauge("deepgo_train_samples_per_sec")
+        rnd = random.Random(7)
+        drive(sampler, clock, g.set,
+              [1000 + rnd.gauss(0, 25) for _ in range(300)])
+        assert det.count == 0
+
+    def test_slow_drift_fires_drift_not_step(self, tmp_path):
+        clock, reg, _store, det, sampler = make_plane(
+            tmp_path, watchlist=(WatchSpec("deepgo_train_samples_per_sec"),))
+        g = reg.gauge("deepgo_train_samples_per_sec")
+        rnd = random.Random(3)
+        drive(sampler, clock, g.set,
+              [1000 + rnd.gauss(0, 8) for _ in range(60)])
+        # ~0.7%/sample decay: each step is noise-sized, the trend is not
+        drive(sampler, clock, g.set,
+              [1000 - 7 * i + rnd.gauss(0, 8) for i in range(120)])
+        assert det.count >= 1
+        assert "drift" in det.by_kind
+
+    def test_failure_counter_increase_fires_without_warmup(self, tmp_path):
+        clock, reg, _store, det, sampler = make_plane(tmp_path)
+        c = reg.counter("deepgo_fleet_failovers_total")
+        sampler.sample_once()  # primes; the labeled series does not exist yet
+        clock.advance(1.0)
+        c.inc(1, fleet="f")  # the kill
+        sampler.sample_once()
+        assert det.count == 1
+        assert det.anomalies[-1].kind == "rate"
+        # detection latency is one sample window by construction
+        assert det.first.t - clock.t == 0.0
+
+    def test_planned_drain_quiet_failed_replica_fires(self, tmp_path):
+        clock, reg, _store, det, sampler = make_plane(tmp_path)
+        g = reg.gauge("deepgo_fleet_replica_state")
+        g.set(1.0, fleet="f", replica="0")
+        drive(sampler, clock, lambda v: g.set(v, fleet="f", replica="0"),
+              [1.0, 0.5, 1.0, 1.0])  # a rolling reload's drain dip
+        assert det.count == 0
+        drive(sampler, clock, lambda v: g.set(v, fleet="f", replica="0"),
+              [0.0])  # the replica actually dies
+        assert det.count == 1
+        assert det.anomalies[-1].kind == "step"
+
+    def test_counter_rate_derives_per_second(self, tmp_path):
+        clock, reg, _store, det, sampler = make_plane(
+            tmp_path, watchlist=(WatchSpec("deepgo_serving_boards_total",
+                                           mode="counter_rate"),))
+        c = reg.counter("deepgo_serving_boards_total")
+        c.inc(0, engine="e")
+        total = 0.0
+        rnd = random.Random(1)
+        # steady ~100 boards/sec with noise: quiet
+        for _ in range(60):
+            total += 100 + rnd.gauss(0, 3)
+            c.inc(100 + rnd.gauss(0, 3), engine="e")
+            clock.advance(1.0)
+            sampler.sample_once()
+        assert det.count == 0
+        # throughput collapses: the rate steps down and fires
+        for _ in range(3):
+            c.inc(5, engine="e")
+            clock.advance(1.0)
+            sampler.sample_once()
+        assert det.count >= 1
+
+    def test_anomaly_counter_and_event_stream(self, tmp_path):
+        clock = FakeClock()
+        reg = MetricsRegistry(clock=clock)
+        sink = JsonlSink(str(tmp_path / "events.jsonl"))
+        store = TimeSeriesStore(str(tmp_path / "ts"), clock=clock,
+                                registry=reg)
+        det = AnomalyDetector(sink=sink, registry=reg, store=store,
+                              flight=False, clock=clock)
+        sampler = TelemetrySampler(store, registry=reg, interval_s=1.0,
+                                   clock=clock, listeners=[det.observe],
+                                   flight_tick=False)
+        c = reg.counter("deepgo_serving_restarts_total")
+        sampler.sample_once()
+        clock.advance(1.0)
+        c.inc(1, engine="bench")
+        sampler.sample_once()
+        sink.close()
+        assert reg.counter("deepgo_anomaly_total").value(
+            metric="deepgo_serving_restarts_total", kind="rate") == 1.0
+        events = [json.loads(l) for l in
+                  open(tmp_path / "events.jsonl")]
+        anomaly = [e for e in events if e["kind"] == "anomaly"]
+        assert len(anomaly) == 1
+        assert anomaly[0]["detector"] == "rate"
+        assert anomaly[0]["series"] == \
+            "deepgo_serving_restarts_total{engine=bench}"
+
+    def test_flight_dump_carries_series_window(self, tmp_path):
+        clock = FakeClock()
+        reg = MetricsRegistry(clock=clock)
+        store = TimeSeriesStore(str(tmp_path / "ts"), clock=clock,
+                                registry=reg)
+        recorder = FlightRecorder(registry=reg, clock=clock)
+        recorder.configure(str(tmp_path / "flight"))
+        det = AnomalyDetector(registry=reg, store=store, flight=False,
+                              clock=clock)
+        # wire the section the detector's flight=True path registers on
+        # the PROCESS recorder, here against a private one
+        recorder.add_section("series_window",
+                             lambda: store.recent_window())
+        sampler = TelemetrySampler(store, registry=reg, interval_s=1.0,
+                                   clock=clock, listeners=[det.observe],
+                                   flight_tick=False)
+        c = reg.counter("deepgo_serving_restarts_total")
+        sampler.sample_once()
+        clock.advance(1.0)
+        c.inc(1, engine="bench")
+        sampler.sample_once()
+        assert det.count == 1
+        path = recorder.dump("anomaly", **det.first.to_dict())
+        dumped = json.load(open(path))
+        window = dumped["series_window"]
+        assert len(window) == 2
+        assert "deepgo_serving_restarts_total{engine=bench}" \
+            in window[-1]["values"]
+        # the surrounding samples are pinned against future decimation
+        assert any(s["t"] in store._pinned or s.get("pin")
+                   for s in window)
+        recorder.close()
+
+    def test_watchlist_is_declared_and_covers_the_issue_metrics(self):
+        families = {w.metric for w in DEFAULT_WATCHLIST}
+        assert "deepgo_serving_boards_total" in families       # boards/sec
+        assert "deepgo_serving_dispatch_seconds" in families   # p99
+        assert "deepgo_fleet_failovers_total" in families      # failovers
+        assert "deepgo_loop_games_ingested_total" in families  # games/hour
+
+
+# ---- federation ----
+
+
+class TestFederation:
+    def test_parse_prometheus_round_trip(self):
+        reg = MetricsRegistry()
+        reg.counter("deepgo_a_total").inc(7, engine="e")
+        reg.gauge("deepgo_b").set(1.5, host="h")
+        h = reg.histogram("deepgo_c_seconds")
+        for v in (0.01, 0.02, 0.03, 0.2):
+            h.observe(v)
+        values = parse_prometheus(render_prometheus(reg))
+        assert values["deepgo_a_total{engine=e}"] == 7.0
+        assert values["deepgo_b{host=h}"] == 1.5
+        assert values["deepgo_c_seconds:count"] == 4.0
+        assert values["deepgo_c_seconds:sum"] == pytest.approx(0.26)
+        assert 0.0 < values["deepgo_c_seconds:p50"] < 0.1
+        assert values["deepgo_c_seconds:p99"] <= 0.25
+
+    def test_with_labels_folds_host_into_existing_labelset(self):
+        out = with_labels({"deepgo_x{engine=e}:p99": 1.0,
+                           "deepgo_y": 2.0}, host="h1")
+        assert out == {"deepgo_x{engine=e,host=h1}:p99": 1.0,
+                       "deepgo_y{host=h1}": 2.0}
+
+    def test_live_federation_with_dead_endpoint(self, tmp_path):
+        regs = []
+        exporters = []
+        for i in range(3):
+            reg = MetricsRegistry()
+            reg.gauge("deepgo_fleet_replicas_serving").set(
+                3 - i, fleet=f"f{i}")
+            exporters.append(ObsExporter(port=0, registry=reg))
+            regs.append(reg)
+        sink = JsonlSink(str(tmp_path / "fed.jsonl"))
+        view = FederatedView(sink=sink, registry=MetricsRegistry())
+        for i, exp in enumerate(exporters):
+            view.add_scrape(f"host{i}", exp.url)
+        dead_port = exporters[0].port  # will be freed below
+        view.add_scrape("deadhost", "http://127.0.0.1:9/metrics")
+        try:
+            collected = view.collect()
+        finally:
+            for exp in exporters:
+                exp.close()
+            sink.close()
+        assert [collected["hosts"][f"host{i}"]["ok"]
+                for i in range(3)] == [True, True, True]
+        assert collected["hosts"]["deadhost"]["ok"] is False
+        # >= 3 hosts joined into ONE labeled view
+        for i in range(3):
+            assert collected["values"][
+                f"deepgo_fleet_replicas_serving{{fleet=f{i},host=host{i}}}"
+            ] == float(3 - i)
+        events = [json.loads(l) for l in open(tmp_path / "fed.jsonl")]
+        failed = [e for e in events if e["kind"] == "ts_scrape_failed"]
+        assert len(failed) == 1 and failed[0]["host"] == "deadhost"
+        assert dead_port  # silence the unused warning honestly
+
+    def test_offline_store_federation(self, tmp_path):
+        clock = FakeClock()
+        dirs = {}
+        for host in ("a", "b", "c"):
+            d = str(tmp_path / host)
+            store = TimeSeriesStore(d, clock=clock,
+                                    registry=MetricsRegistry())
+            for i in range(4):
+                store.append({"deepgo_train_samples_per_sec":
+                              100.0 + i}, t=clock.advance(1.0))
+            store.close()
+            dirs[host] = d
+        dirs["empty"] = str(tmp_path / "empty")  # dead store tolerated
+        merged = store_series(dirs, "deepgo_train_samples_per_sec")
+        assert set(merged) == {
+            "deepgo_train_samples_per_sec{host=a}",
+            "deepgo_train_samples_per_sec{host=b}",
+            "deepgo_train_samples_per_sec{host=c}"}
+        assert all(len(v) == 4 for v in merged.values())
+
+    def test_series_route_serves_recent_window(self, tmp_path):
+        import urllib.request
+
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        store = TimeSeriesStore(str(tmp_path), clock=clock, registry=reg)
+        for i in range(5):
+            store.append({"deepgo_x{engine=e}": float(i)},
+                         t=clock.advance(1.0))
+        set_live_store(store)
+        exporter = ObsExporter(port=0, registry=reg)
+        try:
+            with urllib.request.urlopen(
+                    exporter.url + "/series?metric=deepgo_x") as r:
+                payload = json.loads(r.read())
+            assert payload["enabled"] is True
+            points = payload["series"]["deepgo_x{engine=e}"]
+            assert [v for _, v in points] == [0.0, 1.0, 2.0, 3.0, 4.0]
+            with urllib.request.urlopen(exporter.url + "/series") as r:
+                keys = json.loads(r.read())["keys"]
+            assert "deepgo_x{engine=e}" in keys
+        finally:
+            exporter.close()
+            set_live_store(None)
+            store.close()
+
+
+# ---- dash + trend ----
+
+
+def _write_store_run(tmp_path, clock=None):
+    """A run dir with a ts store, anomaly events, and fleet series."""
+    clock = clock or FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    g = reg.gauge("deepgo_fleet_replicas_serving")
+    state = reg.gauge("deepgo_fleet_replica_state")
+    sps = reg.gauge("deepgo_train_samples_per_sec")
+    burn = reg.gauge("deepgo_slo_burn_ratio")
+    store = TimeSeriesStore(str(tmp_path), clock=clock, registry=reg)
+    sink = JsonlSink(str(tmp_path / "metrics.jsonl"))
+    det = AnomalyDetector(sink=sink, registry=reg, store=store,
+                          flight=False, clock=clock)
+    sampler = TelemetrySampler(store, registry=reg, interval_s=1.0,
+                               clock=clock, listeners=[det.observe],
+                               flight_tick=False)
+    c = reg.counter("deepgo_fleet_failovers_total")
+    g.set(3, fleet="f")
+    for r in range(3):
+        state.set(1.0, fleet="f", replica=str(r))
+    burn.set(0.2, slo="dispatch", window="fast")
+    for i in range(12):
+        sps.set(1000.0 + i)
+        clock.advance(1.0)
+        sampler.sample_once()
+    c.inc(1, fleet="f")  # one failover -> one anomaly event on record
+    state.set(0.0, fleet="f", replica="2")
+    clock.advance(1.0)
+    sampler.sample_once()
+    store.close()
+    sink.close()
+    assert det.count >= 1
+    return str(tmp_path)
+
+
+class TestDash:
+    def test_collect_and_render_store_mode(self, tmp_path):
+        from deepgo_tpu.obs.dash import collect_dash, render_dash
+
+        run_dir = _write_store_run(tmp_path)
+        data = collect_dash(run_dir)
+        assert data["mode"] == "store"
+        assert data["samples"] == 13
+        assert data["anomalies"], "recorded anomaly events surface"
+        fleet = data["fleet"]["local"]
+        assert fleet["replicas_serving"] == 3.0
+        assert fleet["replica_state"]["2"] == 0.0
+        out = render_dash(data)
+        assert "watchlist:" in out
+        assert "fleet health:" in out
+        assert "r2:DOWN" in out
+        assert "anomalies" in out
+        assert "slo burn:" in out
+        # sparklines actually render block characters
+        assert any(ch in out for ch in "▁▂▃▄▅▆▇█")
+
+    def test_cli_dash_once_and_json_round_trip(self, tmp_path, capsys):
+        from deepgo_tpu.cli import main
+
+        run_dir = _write_store_run(tmp_path)
+        main(["dash", run_dir, "--once"])
+        rendered = capsys.readouterr().out
+        assert "fleet health:" in rendered
+        main(["dash", run_dir, "--json"])
+        data = json.loads(capsys.readouterr().out)
+        assert data["mode"] == "store"
+        assert data["fleet"]["local"]["replicas_serving"] == 3.0
+        assert data["anomalies"][0]["detector"] in ("rate", "step")
+
+    def test_cli_dash_requires_a_source(self):
+        from deepgo_tpu.cli import main
+
+        with pytest.raises(SystemExit):
+            main(["dash"])
+
+    def test_dash_scrape_mode_grows_history(self):
+        from deepgo_tpu.obs.dash import DashHistory, collect_dash
+
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        g = reg.gauge("deepgo_train_samples_per_sec")
+        view = FederatedView(registry=MetricsRegistry(), clock=clock)
+        view.add_getter(
+            "h1", lambda: flatten_snapshot(reg.snapshot()["metrics"]))
+        history = DashHistory()
+        for i in range(5):
+            g.set(100.0 + i)
+            clock.advance(1.0)
+            data = collect_dash(view=view, history=history)
+        assert data["mode"] == "scrape"
+        assert data["samples"] == 5
+        key = "deepgo_train_samples_per_sec{host=h1}"
+        points = data["watchlist"]["deepgo_train_samples_per_sec"][key]
+        assert [v for _, v in points["points"]] == [100, 101, 102, 103, 104]
+
+
+class TestTrend:
+    def _write_rounds(self, root):
+        # the r06+ shape
+        with open(os.path.join(root, "BENCH_r06.json"), "w") as f:
+            json.dump({"round": 6, "captures": {
+                "inference": {"metric": "m_boards", "value": 74.2,
+                              "unit": "boards/sec", "device": "cpu"},
+                "serving": {"metric": "m_serving", "value": 313.1,
+                            "unit": "boards/sec", "device": "cpu"},
+            }}, f)
+        # the r01-r05 driver shape, stale capture
+        with open(os.path.join(root, "BENCH_r05.json"), "w") as f:
+            json.dump({"n": 5, "rc": 0, "parsed": {
+                "metric": "m_boards", "value": 104034.1, "stale": True,
+                "last_good": {"device": "tpu"}}}, f)
+        with open(os.path.join(root, "BENCH_r04.json"), "w") as f:
+            f.write("{not json")
+        with open(os.path.join(root, "BENCH_LAST_GOOD.json"), "w") as f:
+            json.dump({"m_boards": {"metric": "m_boards",
+                                    "value": 104034.1, "device": "tpu",
+                                    "timestamp": "T"}}, f)
+
+    def test_collect_and_render(self, tmp_path):
+        from deepgo_tpu.obs.dash import collect_trend, render_trend
+
+        self._write_rounds(str(tmp_path))
+        data = collect_trend(str(tmp_path))
+        assert data["rounds"] == [5, 6]
+        assert data["metrics"]["m_boards"][5]["stale"] is True
+        assert data["metrics"]["m_boards"][6]["value"] == 74.2
+        assert data["last_good"]["m_boards"]["value"] == 104034.1
+        assert data["skipped"] == ["BENCH_r04.json"]
+        out = render_trend(data)
+        assert "m_boards" in out and "m_serving" in out
+        assert "104034*" in out.replace(" ", "")  # stale marked
+        assert "last-good" in out
+
+    def test_cli_trend_json(self, tmp_path, capsys):
+        from deepgo_tpu.cli import main
+
+        self._write_rounds(str(tmp_path))
+        main(["trend", "--root", str(tmp_path), "--json"])
+        data = json.loads(capsys.readouterr().out)
+        assert data["rounds"] == [5, 6]
+
+    def test_trend_over_the_real_repo_history(self):
+        from deepgo_tpu.obs.dash import collect_trend
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        data = collect_trend(root)
+        assert 7 in data["rounds"]  # the r07 capture of this PR
+        assert "policy_inference_boards_per_sec_per_chip" in data["metrics"]
+
+
+# ---- cli obs sections ----
+
+
+class TestReportSections:
+    def test_obs_report_gains_timeseries_and_anomalies(self, tmp_path):
+        from deepgo_tpu.obs.report import format_report, summarize_run
+
+        run_dir = _write_store_run(tmp_path)
+        summary = summarize_run(run_dir)
+        ts = summary["timeseries"]
+        assert ts["samples"] == 13
+        assert ts["series"] >= 4
+        assert ts["pinned"] >= 1  # the anomaly pinned its window
+        assert any(k.startswith("deepgo_train_samples_per_sec")
+                   for k in ts["watch"])
+        anom = summary["anomalies"]
+        assert anom["count"] >= 1
+        assert anom["events"][0]["detector"] in ("rate", "step")
+        out = format_report(summary)
+        assert "telemetry time-series" in out
+        assert "anomalies (" in out
