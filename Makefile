@@ -3,7 +3,8 @@
 .PHONY: native data test test-full lint verify verify-faults verify-serving \
     verify-resilience verify-fleet verify-distributed verify-obs \
     verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
-    verify-cost verify-quant verify-telemetry bench bench-gate smoke clean
+    verify-cost verify-quant verify-telemetry verify-workload bench \
+    bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -69,7 +70,10 @@ verify-quant:  # int8 + fused-sym serving variants: po2 bitwise identity, per-ru
 verify-telemetry:  # fleet telemetry plane: fake-clock sampler cadence, retention/downsample pinning, anomaly matrix, dead-endpoint federation, dash --once/--json, trend
 	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry  # the full failure-model suite
+verify-workload:  # workload observatory: dihedral canonicalization, torn-line capture reads, off-mode-free recorder, open-loop replay fidelity, synthetic generator determinism, cli record/analyze/replay
+	JAX_PLATFORMS=cpu python -m pytest tests/test_workload.py -q
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload  # the full failure-model suite
 
 bench:
 	python bench.py

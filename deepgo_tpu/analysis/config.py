@@ -109,10 +109,12 @@ class LintConfig:
     # cost_* (the AOT device cost ledger) in ISSUE 12; ts_* and
     # anomaly_* (the fleet telemetry plane: sample/scrape-failure
     # events; the `anomaly` event itself is prefix-free by name and
-    # documented next to them) in ISSUE 14.
+    # documented next to them) in ISSUE 14; workload_* (the workload
+    # observatory capture streams: request/position/capture-summary
+    # records) in ISSUE 15.
     grammar_prefixes: tuple = ("deepgo_", "obs_", "loop_", "fleet_",
                                "trace_", "lineage_", "cost_", "ts_",
-                               "anomaly_")
+                               "anomaly_", "workload_")
     # doc tokens that share a grammar prefix but are not metrics/events:
     # bench JSON keys and similar
     grammar_ignore: frozenset = frozenset({

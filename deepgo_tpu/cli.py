@@ -454,6 +454,114 @@ def cmd_trend(args) -> None:
         print(dash_mod.render_trend(data))
 
 
+def _workload_engine(args):
+    """The record/replay target: a FleetRouter over --fleet supervised
+    policy replicas (the cmd_serve build, without the daemon loop)."""
+    from .models import policy_cnn
+    from .serving import EngineConfig, fleet_policy_engine
+
+    if getattr(args, "checkpoint", None):
+        from .models.serving import load_policy
+
+        _, params, cfg = load_policy(args.checkpoint)
+    else:
+        import jax
+
+        cfg = policy_cnn.CONFIGS[args.model]
+        params = policy_cnn.init(jax.random.key(0), cfg)
+    fleet = fleet_policy_engine(
+        params, cfg, replicas=args.fleet,
+        config=EngineConfig(max_wait_ms=args.max_wait_ms))
+    fleet.warmup()
+    return fleet
+
+
+def cmd_workload(args) -> None:
+    """The workload observatory (obs/workload.py + serving/replay.py,
+    docs/observability.md "Workload observatory"):
+
+    ``record``   drive a live fleet with a deterministic opening-heavy
+                 synthetic workload (real game openings via the go/
+                 rules engine, Zipf-skewed popularity, Poisson arrivals)
+                 with the workload recorder armed — producing a REAL
+                 capture: per-request content digests, canonical
+                 8-fold-symmetry keys, tiers, buckets, outcomes,
+                 latencies, plus the deduplicated position store.
+    ``analyze``  characterize a capture: unique-vs-total positions,
+                 symmetry-dedup gain, popularity skew, burstiness, tier
+                 mix, and the projected cache hit rate.
+    ``replay``   re-serve a capture against a live fleet with open-loop
+                 arrival fidelity at --speed x, reporting timeline error
+                 vs the recorded arrivals next to the served outcomes."""
+    import json as _json
+
+    from .obs import workload as workload_mod
+
+    if args.wcmd == "analyze":
+        stats = workload_mod.analyze_capture(args.capture)
+        if args.json:
+            print(_json.dumps(stats, indent=1, default=str))
+        else:
+            print(workload_mod.format_workload(stats))
+        return
+
+    from .serving import replay as replay_mod
+
+    if args.wcmd == "record":
+        items = replay_mod.build_synthetic_requests(
+            args.sgf_dir, requests=args.requests, games=args.games,
+            opening_moves=args.opening_moves, rate_per_s=args.rate,
+            zipf_s=args.zipf, seed=args.seed)
+        recorder = workload_mod.configure_workload(args.out)
+        fleet = _workload_engine(args)
+        try:
+            replayed = replay_mod.WorkloadReplayer(
+                fleet, items, speed=args.speed).run()
+        finally:
+            fleet.close()
+            recorder.drain()
+            workload_mod.disable_workload()
+        stats = workload_mod.analyze_capture(args.out)
+        out = {"capture": args.out, "drive": replayed, "workload": stats}
+        if args.json:
+            print(_json.dumps(out, indent=1, default=str))
+        else:
+            print(f"recorded {stats['requests']} request(s) -> {args.out}")
+            print(workload_mod.format_workload(stats))
+        return
+
+    # replay: fidelity vs the recorded timeline + live outcomes
+    source = workload_mod.analyze_capture(args.capture)
+    trace = replay_mod.load_trace(args.capture)
+    fleet = _workload_engine(args)
+    try:
+        report = replay_mod.WorkloadReplayer(
+            fleet, trace, speed=args.speed,
+            timeout_s=args.timeout or None).run()
+    finally:
+        fleet.close()
+    report["capture"] = args.capture
+    report["mix_match"] = (
+        report["requests"] == source.get("requests")
+        and report["tiers"] == source.get("tiers"))
+    if args.json:
+        print(_json.dumps(report, indent=1, default=str))
+    else:
+        print(f"replayed {report['requests']} request(s) from "
+              f"{args.capture} at {args.speed:g}x")
+        print(f"  timeline: span {report['actual_span_s']}s vs target "
+              f"{report['target_span_s']}s (error "
+              f"{report['span_error_frac']:.2%}, mean lag "
+              f"{report['mean_lag_ms']}ms, p99 {report['p99_lag_ms']}ms) "
+              f"fidelity_ok={report['fidelity_ok']}")
+        print(f"  mix: tiers {report['tiers']} "
+              f"(matches capture: {report['mix_match']})")
+        print(f"  outcomes: {report['outcomes']}  "
+              f"{report['boards_per_sec']} boards/sec")
+    if not report["fidelity_ok"]:
+        raise SystemExit(1)
+
+
 def cmd_trace(args) -> None:
     """Request waterfall / lineage chain reconstruction (obs/tracing.py).
 
@@ -884,6 +992,70 @@ def main(argv=None) -> None:
     p.add_argument("--json", action="store_true",
                    help="emit the joined history as JSON")
     p.set_defaults(fn=cmd_trend)
+
+    p = sub.add_parser("workload", help="workload observatory: record a "
+                                        "live opening-heavy capture, "
+                                        "characterize it (dup ratio, "
+                                        "projected cache hit rate), or "
+                                        "replay it with open-loop arrival "
+                                        "fidelity (docs/observability.md)")
+    wsub = p.add_subparsers(dest="wcmd", required=True)
+
+    def _workload_target_args(w) -> None:
+        w.add_argument("--fleet", type=int, default=2, metavar="N",
+                       help="replicas in the target fleet (default 2)")
+        w.add_argument("--model", default="small",
+                       help="policy config for a random-init fleet "
+                            "(default small)")
+        w.add_argument("--checkpoint", default=None,
+                       help="serve this checkpoint instead of random init")
+        w.add_argument("--max-wait-ms", type=float, default=2.0)
+        w.add_argument("--speed", type=float, default=1.0,
+                       help="arrival-timeline speedup (1.0 = recorded "
+                            "pace, N = N-times faster)")
+        w.add_argument("--json", action="store_true")
+
+    w = wsub.add_parser("record", help="drive a live fleet with the "
+                                       "synthetic opening-heavy workload "
+                                       "and capture it")
+    w.add_argument("--out", required=True, metavar="DIR",
+                   help="capture directory (workload.jsonl + "
+                        "positions.jsonl)")
+    w.add_argument("--requests", type=int, default=256)
+    w.add_argument("--games", type=int, default=16,
+                   help="real games whose openings build the position "
+                        "pool")
+    w.add_argument("--opening-moves", type=int, default=10,
+                   help="plies kept per game (the opening tree depth)")
+    w.add_argument("--rate", type=float, default=200.0, metavar="REQ/S",
+                   help="Poisson arrival rate of the synthetic trace")
+    w.add_argument("--zipf", type=float, default=1.1,
+                   help="popularity-skew exponent over move depth")
+    w.add_argument("--seed", type=int, default=0,
+                   help="the trace is a pure function of this seed")
+    w.add_argument("--sgf-dir", default="data/sgf/train")
+    _workload_target_args(w)
+    w.set_defaults(fn=cmd_workload)
+
+    w = wsub.add_parser("analyze", help="characterization report over a "
+                                        "capture: unique/canonical "
+                                        "positions, symmetry-dedup gain, "
+                                        "popularity skew, burstiness, "
+                                        "projected cache hit rate")
+    w.add_argument("capture", help="capture directory (or workload.jsonl)")
+    w.add_argument("--json", action="store_true")
+    w.set_defaults(fn=cmd_workload)
+
+    w = wsub.add_parser("replay", help="re-serve a capture against a live "
+                                       "fleet at the recorded arrival "
+                                       "pace (open loop); exits nonzero "
+                                       "when timeline fidelity misses "
+                                       "the 10%% bar")
+    w.add_argument("capture")
+    w.add_argument("--timeout", type=float, default=0.0, metavar="S",
+                   help="per-request deadline (0 = none)")
+    _workload_target_args(w)
+    w.set_defaults(fn=cmd_workload)
 
     # "selfplay" is forwarded before parsing (above); listed here so it
     # shows up in --help output

@@ -83,5 +83,10 @@ from .timeseries import (TelemetrySampler, TimeSeriesStore,  # noqa: F401
                          series_from_samples, set_live_store)
 from .anomaly import (DEFAULT_WATCHLIST, Anomaly,  # noqa: F401
                       AnomalyDetector, WatchSpec)
+from .workload import (WorkloadCaptureError, WorkloadRecorder,  # noqa: F401
+                       WorkloadToken, analyze_capture, canonical_digest,
+                       characterize, configure_workload, disable_workload,
+                       exact_digest, format_workload, get_workload_recorder,
+                       load_capture, note_request, workload_enabled)
 from .federate import (FederatedView, parse_prometheus,  # noqa: F401
                        scrape_series, store_series, with_labels)

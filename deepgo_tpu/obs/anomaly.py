@@ -116,6 +116,11 @@ DEFAULT_WATCHLIST: tuple[WatchSpec, ...] = (
     # replicas_serving (drain is not an incident); a replica hitting the
     # FAILED state is one
     WatchSpec("deepgo_fleet_replica_state", mode="drop", drop_to=0.0),
+    # per-tier arrival rate (the workload recorder's counter, one series
+    # per tier label): the dash sparkline that shows WHO is hammering
+    # the fleet, and a collapse in interactive arrivals is an incident
+    # even when the fleet itself is healthy
+    WatchSpec("deepgo_workload_requests_total", mode="counter_rate"),
     WatchSpec("deepgo_loop_games_ingested_total", mode="counter_rate"),
     WatchSpec("deepgo_loop_stalls_total", mode="increase"),
     WatchSpec("deepgo_loop_component_restarts_total", mode="increase"),

@@ -80,18 +80,40 @@ class DashHistory:
         return list(self._samples)
 
 
+def _rate_points(points: list[tuple[float, float]]
+                 ) -> list[tuple[float, float]]:
+    """Cumulative counter samples -> per-second rate points (successive
+    differences over the sample gap; a counter reset clamps at 0)."""
+    out = []
+    for (t0, v0), (t1, v1) in zip(points, points[1:]):
+        dt = t1 - t0
+        if dt > 0:
+            out.append((t1, max(v1 - v0, 0.0) / dt))
+    return out
+
+
 def _watch_section(samples: list[dict], window: int) -> dict:
     tail = samples[-window:]
     out: dict = {}
     for spec in DEFAULT_WATCHLIST:
         metric = spec.metric if spec.field is None \
             else f"{spec.metric}:{spec.field}"
+        # counter families sparkline as RATES (boards/sec, requests/sec
+        # per tier) — a monotone cumulative count hides exactly the
+        # "when did it change" signal a sparkline exists to show
+        rate = spec.mode == "counter_rate"
+        if rate:
+            metric = f"{spec.metric}:rate"
         per_key = {k: v for k, v in series_from_samples(
             tail, spec.metric).items() if key_field(k) == spec.field}
         if not per_key:
             continue
         rows = {}
         for key, points in sorted(per_key.items()):
+            if rate:
+                points = _rate_points(points)
+                if not points:
+                    continue
             values = [v for _, v in points]
             rows[key] = {
                 "points": points,
@@ -99,7 +121,8 @@ def _watch_section(samples: list[dict], window: int) -> dict:
                 "min": min(values),
                 "max": max(values),
             }
-        out[metric] = rows
+        if rows:
+            out[metric] = rows
     return out
 
 
@@ -239,13 +262,16 @@ def render_dash(data: dict, width: int = 40) -> str:
         label_w = max((len(k) for rows in watch.values() for k in rows),
                       default=0)
         label_w = min(label_w, 72)
-        for _metric, rows in watch.items():
+        for metric, rows in watch.items():
+            # rate-derived families (":rate") show per-second values
+            unit = "/s" if metric.endswith(":rate") else ""
             for key, row in rows.items():
                 lines.append(
                     f"  {key[:72].ljust(label_w)}  "
                     f"{sparkline(row['points'], width).ljust(width)}  "
-                    f"last {_fmt(row['last'], key)}  "
-                    f"[{_fmt(row['min'], key)} .. {_fmt(row['max'], key)}]")
+                    f"last {_fmt(row['last'], key)}{unit}  "
+                    f"[{_fmt(row['min'], key)} .. "
+                    f"{_fmt(row['max'], key)}{unit}]")
     fleet = data.get("fleet", {})
     if fleet:
         lines.append("")

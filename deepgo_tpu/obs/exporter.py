@@ -42,19 +42,27 @@ from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
 class JsonlSink:
     """Append-only JSONL event stream with size-based rotation."""
 
-    def __init__(self, path: str, max_bytes: int = 0, max_files: int = 5):
+    def __init__(self, path: str, max_bytes: int = 0, max_files: int = 5,
+                 buffering: int = 1):
         """``max_bytes=0`` disables rotation (the historical MetricsWriter
         behavior). With rotation on, a write that would push the current
         file past ``max_bytes`` first shifts ``path.N`` -> ``path.N+1``
         (dropping anything past ``max_files``) and renames ``path`` to
         ``path.1`` — newest-first numbering, logrotate-style, so readers
-        concatenate ``path.N .. path.1, path`` for the full stream."""
+        concatenate ``path.N .. path.1, path`` for the full stream.
+        ``buffering`` is the underlying file mode: 1 (default) flushes
+        per line — every record durable the instant write() returns;
+        high-rate streams (the workload recorder) pass a block size and
+        ``flush()`` on idle instead, trading bounded staleness for not
+        paying a syscall per record (readers are torn-line-tolerant
+        either way)."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self.max_bytes = max_bytes
         self.max_files = max_files
+        self._buffering = buffering
         self._lock = make_lock(f"obs.sink.{os.path.basename(path)}")
-        self._f = open(path, "a", buffering=1)
+        self._f = open(path, "a", buffering=buffering)
         self._size = self._f.tell()
 
     def write(self, kind: str, **fields) -> None:
@@ -76,8 +84,14 @@ class JsonlSink:
             if os.path.exists(src):
                 os.replace(src, f"{self.path}.{n + 1}")
         os.replace(self.path, f"{self.path}.1")
-        self._f = open(self.path, "a", buffering=1)
+        self._f = open(self.path, "a", buffering=self._buffering)
         self._size = 0
+
+    def flush(self) -> None:
+        """Push buffered records to the OS (block-buffered sinks)."""
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
 
     def close(self) -> None:
         """Idempotent: the supervisor, the experiment, and an atexit hook

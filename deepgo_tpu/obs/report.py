@@ -405,6 +405,20 @@ def summarize_run(run_dir: str) -> dict:
                 1 for s in scrape_failures if s.get("host") == r.get("host"))
             for r in scrape_failures}
 
+    # ---- workload observatory (the obs/workload.py capture streams —
+    # a bench run's <flight-dir>/workload/ or a capture dir itself):
+    # what the run was ASKED to serve, characterized — the projected
+    # cache hit rate next to the dispatch latencies it would remove
+    wl_dir = None
+    for cand in (os.path.join(run_dir, "workload"), run_dir):
+        if os.path.exists(os.path.join(cand, "workload.jsonl")):
+            wl_dir = cand
+            break
+    if wl_dir is not None:
+        from .workload import analyze_capture
+
+        summary["workload"] = analyze_capture(wl_dir)
+
     # ---- the AOT device cost ledger (cost_ledger events streamed by
     # obs/costmodel.py at train start / bench warmup): the per-entrypoint
     # FLOPs / bytes / HBM bill the attribution roofline divides by
@@ -525,6 +539,15 @@ def format_report(summary: dict) -> str:
                 f"{e.get('series') or e.get('metric')}  "
                 f"value {e.get('value')} vs baseline {e.get('baseline')} "
                 f"(score {e.get('score')})")
+    wl = summary.get("workload")
+    if wl:
+        from .workload import format_workload
+
+        lines.append("")
+        lines.append("workload (obs/workload.py capture — "
+                     "`cli workload analyze` for the full report):")
+        for row in format_workload(wl).splitlines():
+            lines.append(f"  {row}")
     cost = summary.get("cost_ledger")
     if cost:
         lines.append("")

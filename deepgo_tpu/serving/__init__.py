@@ -38,6 +38,8 @@ from .engine import (BatchDispatchError, EngineBusy,  # noqa: F401
 from .resilience import (CircuitBreaker, CircuitOpen,  # noqa: F401
                          EngineOverloaded, PoisonedRequest,
                          RestartsExhausted, full_jitter_delay)
+from .replay import (WorkloadReplayer, build_synthetic_requests,  # noqa: F401
+                     load_trace, write_synthetic_capture)
 from .supervisor import SupervisedEngine, SupervisorConfig  # noqa: F401
 from .fleet import (TIERS, FailoverExhausted, FleetConfig,  # noqa: F401
                     FleetReloadError, FleetRouter, FleetUnavailable)

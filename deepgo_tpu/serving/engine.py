@@ -84,10 +84,10 @@ class EngineConfig:
 
 class _Request:
     __slots__ = ("packed", "player", "rank", "future", "t_submit", "deadline",
-                 "solo", "trace")
+                 "solo", "trace", "workload")
 
     def __init__(self, packed, player, rank, deadline, solo=False,
-                 trace=None):
+                 trace=None, workload=None):
         self.packed = packed
         self.player = player
         self.rank = rank
@@ -96,6 +96,7 @@ class _Request:
         self.deadline = deadline
         self.solo = solo
         self.trace = trace  # obs.tracing.TraceContext, or None (off)
+        self.workload = workload  # obs.workload.WorkloadToken, or None (off)
 
 
 class InferenceEngine:
@@ -271,7 +272,7 @@ class InferenceEngine:
 
     def submit(self, packed: np.ndarray, player: int, rank: int,
                timeout_s: float | None = None, block: bool = True,
-               solo: bool = False, trace=None) -> Future:
+               solo: bool = False, trace=None, workload=None) -> Future:
         """Queue one board; returns a Future resolving to its result row.
 
         ``timeout_s`` (default: config.timeout_s) bounds queue-to-result
@@ -285,7 +286,10 @@ class InferenceEngine:
         TraceContext (obs/tracing.py) — the timeline gains queued/
         coalesced/dispatched/resolved stamps; when tracing is armed and
         no outer layer owns the request, the engine starts (and
-        finishes) a trace of its own."""
+        finishes) a trace of its own. ``workload`` is the caller's
+        WorkloadToken (obs/workload.py) under the same ownership rule —
+        the outermost layer records arrival/outcome, the engine stamps
+        the bucket the request coalesced into."""
         self._check_alive()
         owned = None
         if trace is None:
@@ -294,12 +298,20 @@ class InferenceEngine:
             trace = owned = tracing.start_request(engine=self.name)
         if trace is not None:
             trace.mark("queued", engine=self.name)
+        wl_owned = None
+        if workload is None:
+            from ..obs import workload as workload_mod
+
+            workload = wl_owned = workload_mod.note_request(
+                packed, player, rank, engine=self.name)
         timeout_s = self.config.timeout_s if timeout_s is None else timeout_s
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         req = _Request(np.asarray(packed), int(player), int(rank), deadline,
-                       solo=solo, trace=trace)
+                       solo=solo, trace=trace, workload=workload)
         if owned is not None:
             req.future.add_done_callback(owned.finish_future)
+        if wl_owned is not None:
+            req.future.add_done_callback(wl_owned.finish_future)
         if solo:
             self._solo.append(req)
             return req.future
@@ -376,6 +388,11 @@ class InferenceEngine:
             return
         n = len(live)
         bucket = self.ladder.bucket_for(n)
+        for r in live:
+            # workload tap: one attr set per armed request — the record
+            # gains the ladder rung the request actually dispatched on
+            if r.workload is not None:
+                r.workload.bucket = bucket
         traced = [r for r in live if r.trace is not None]
         for r in traced:
             r.trace.mark("coalesced", engine=self.name, batch=n,

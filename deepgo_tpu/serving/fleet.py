@@ -134,10 +134,10 @@ class FleetConfig:
 class _FleetRequest:
     __slots__ = ("packed", "player", "rank", "tier", "deadline", "future",
                  "excluded", "failovers", "t_submit", "t_first_failure",
-                 "last_error", "trace")
+                 "last_error", "trace", "workload")
 
     def __init__(self, packed, player, rank, tier, deadline, t_submit,
-                 trace=None):
+                 trace=None, workload=None):
         self.packed = packed
         self.player = player
         self.rank = rank
@@ -150,6 +150,7 @@ class _FleetRequest:
         self.t_first_failure: float | None = None
         self.last_error: BaseException | None = None
         self.trace = trace                # one id across every hop
+        self.workload = workload          # WorkloadToken, fleet-owned
 
 
 class _Replica:
@@ -347,15 +348,23 @@ class FleetRouter:
         deadline = None if timeout_s is None else now + timeout_s
         # the fleet door is the outermost serving layer: it owns the
         # request's TraceContext — one trace id across every placement,
-        # failover hop, replica restart, and the final resolution
+        # failover hop, replica restart, and the final resolution —
+        # and, under the same ownership rule, the request's
+        # WorkloadToken (obs/workload.py): arrival + tier recorded
+        # here, the bucket stamped by whichever engine dispatches it
         from ..obs import tracing
+        from ..obs import workload as workload_mod
 
         trace = tracing.start_request(fleet=self.name, tier=tier)
+        wl = workload_mod.note_request(packed, player, rank, tier=tier,
+                                       fleet=self.name)
         req = _FleetRequest(np.asarray(packed), int(player), int(rank),
-                            tier, deadline, now, trace=trace)
+                            tier, deadline, now, trace=trace, workload=wl)
         if trace is not None:
             trace.mark("queued", fleet=self.name, tier=tier)
             req.future.add_done_callback(trace.finish_future)
+        if wl is not None:
+            req.future.add_done_callback(wl.finish_future)
         self._dispatch(req, block=block)
         if req.future.done():
             exc = req.future.exception()
@@ -446,9 +455,11 @@ class FleetRouter:
                 req.trace.set(replica=rep.idx)
             try:
                 faults.check("fleet_route")
-                # the trace kwarg only travels when armed, so scripted
-                # duck-typed replicas (tests) keep their plain signature
+                # the trace/workload kwargs only travel when armed, so
+                # scripted duck-typed replicas keep their plain signature
                 kw = {} if req.trace is None else {"trace": req.trace}
+                if req.workload is not None:
+                    kw["workload"] = req.workload
                 inner = rep.engine.submit(req.packed, req.player, req.rank,
                                           timeout_s=remaining, block=block,
                                           **kw)

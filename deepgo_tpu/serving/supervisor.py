@@ -94,9 +94,10 @@ class SupervisorConfig:
 
 class _SupRequest:
     __slots__ = ("packed", "player", "rank", "deadline", "future",
-                 "solo", "solo_failures", "trace")
+                 "solo", "solo_failures", "trace", "workload")
 
-    def __init__(self, packed, player, rank, deadline, trace=None):
+    def __init__(self, packed, player, rank, deadline, trace=None,
+                 workload=None):
         self.packed = packed
         self.player = player
         self.rank = rank
@@ -105,6 +106,7 @@ class _SupRequest:
         self.solo = False                 # isolation-lane retry
         self.solo_failures = 0            # times it failed dispatching alone
         self.trace = trace                # TraceContext riding every retry
+        self.workload = workload          # WorkloadToken, same discipline
 
 
 class SupervisedEngine:
@@ -250,7 +252,7 @@ class SupervisedEngine:
 
     def submit(self, packed: np.ndarray, player: int, rank: int,
                timeout_s: float | None = None, block: bool = True,
-               trace=None) -> Future:
+               trace=None, workload=None) -> Future:
         """Queue one board; returns a Future that ALWAYS resolves.
 
         Outcomes: the result row (possibly after transparent engine
@@ -290,11 +292,19 @@ class SupervisedEngine:
             from ..obs import tracing
 
             trace = owned = tracing.start_request(engine=self.name)
+        wl_owned = None
+        if workload is None:
+            from ..obs import workload as workload_mod
+
+            workload = wl_owned = workload_mod.note_request(
+                packed, player, rank, engine=self.name)
         deadline = None if timeout_s is None else self._clock() + timeout_s
         req = _SupRequest(np.asarray(packed), int(player), int(rank),
-                          deadline, trace=trace)
+                          deadline, trace=trace, workload=workload)
         if owned is not None:
             req.future.add_done_callback(owned.finish_future)
+        if wl_owned is not None:
+            req.future.add_done_callback(wl_owned.finish_future)
         try:
             self._submit_inner(req, block=block)
         except EngineBusy:
@@ -303,6 +313,8 @@ class SupervisedEngine:
             self._breaker.cancel_probe()
             if owned is not None:
                 owned.finish("error", error="EngineBusy")
+            if wl_owned is not None:
+                wl_owned.finish("shed")
             raise
         return req.future
 
@@ -346,7 +358,8 @@ class SupervisedEngine:
         try:
             inner = engine.submit(req.packed, req.player, req.rank,
                                   timeout_s=remaining, block=block,
-                                  solo=req.solo, trace=req.trace)
+                                  solo=req.solo, trace=req.trace,
+                                  workload=req.workload)
         except EngineBusy:
             raise
         except EngineError:

@@ -1,0 +1,627 @@
+"""Workload observatory (obs/workload.py + serving/replay.py).
+
+The load-bearing contracts:
+
+  * canonicalization: all 8 dihedral views of a position map to ONE
+    canonical key (the group-orbit property), the permutation tables
+    match ops/augment's, and distinct positions never collide over a
+    real-game corpus;
+  * capture reads are torn-line tolerant and round-trip through the
+    deduplicated position store (a capture is replayable);
+  * the recorder is FREE when off (``note_request`` returns None, no
+    token rides the request, nothing is written) and counts every
+    request exactly once when on — fleet -> supervisor -> engine is one
+    record, not three;
+  * open-loop replay reproduces the recorded request count and tier mix
+    exactly, and the replayed inter-arrival timeline sits within the
+    10% fidelity bar;
+  * the synthetic opening-heavy generator is a pure function of its
+    seed;
+  * ``cli workload record|analyze|replay`` and the ``cli obs`` workload
+    section surface all of it.
+"""
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from deepgo_tpu.obs import workload as wl
+from deepgo_tpu.obs.exporter import JsonlSink
+from deepgo_tpu.serving import replay as rp
+from deepgo_tpu.serving import (EngineConfig, FleetRouter, InferenceEngine,
+                                SupervisedEngine)
+
+SGF_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "data", "sgf", "train")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    wl.disable_workload()
+    yield
+    wl.disable_workload()
+
+
+def ok_forward(params, packed, player, rank):
+    return np.asarray(packed, np.float32).sum(axis=(1, 2, 3)) \
+        + 1000.0 * np.asarray(player, np.float32)
+
+
+def rand_packed(rng, n=1):
+    return rng.integers(0, 3, size=(n, 9, 19, 19), dtype=np.uint8)
+
+
+def make_engine(name="wl-test", buckets=(1, 8)):
+    eng = InferenceEngine(ok_forward, None,
+                          EngineConfig(buckets=buckets, max_wait_ms=1.0),
+                          name=name)
+    eng.warmup()
+    return eng
+
+
+# ---------------------------------------------------------------------------
+# digests + canonicalization
+
+
+class TestCanonicalization:
+    def test_perm_tables_match_ops_augment(self):
+        from deepgo_tpu.ops import augment
+
+        np.testing.assert_array_equal(wl._PERMS, augment._PERM_NP)
+
+    def test_all_eight_views_share_one_canonical_key(self):
+        rng = np.random.default_rng(0)
+        for i in range(5):
+            packed = rand_packed(rng)[0]
+            views = wl.dihedral_views(packed)
+            assert len(views) == 8
+            canon = {wl.canonical_digest(v, 1, 5) for v in views}
+            assert len(canon) == 1
+            # the views themselves are genuinely distinct inputs
+            exact = {wl.exact_digest(v, 1, 5) for v in views}
+            assert len(exact) == 8
+
+    def test_real_corpus_positions_never_collide(self):
+        # every position of a few real games: distinct boards -> distinct
+        # exact digests AND distinct canonical keys (a canonical
+        # collision would alias two different positions in the cache)
+        pool = rp._opening_pool(SGF_DIR, games=4, opening_moves=30)
+        exact = {}
+        canon = {}
+        for p in pool:
+            d = wl.exact_digest(p["packed"], p["player"], p["rank"])
+            c = wl.canonical_digest(p["packed"], p["player"], p["rank"])
+            if d in exact:
+                # identical boards may legitimately repeat across games
+                # (shared opening tree) — only DIFFERENT boards colliding
+                # is a failure
+                assert np.array_equal(exact[d], p["packed"])
+            else:
+                exact[d] = p["packed"]
+            if c in canon:
+                views = [v.tobytes() for v in
+                         wl.dihedral_views(canon[c])]
+                assert p["packed"].tobytes() in views
+            else:
+                canon[c] = p["packed"]
+        assert len(exact) > 20
+
+    def test_player_and_rank_key_the_digest(self):
+        packed = rand_packed(np.random.default_rng(1))[0]
+        assert wl.exact_digest(packed, 1, 5) != wl.exact_digest(packed, 2, 5)
+        assert wl.exact_digest(packed, 1, 5) != wl.exact_digest(packed, 1, 6)
+        assert wl.canonical_digest(packed, 1, 5) \
+            != wl.canonical_digest(packed, 2, 5)
+
+    def test_canonical_stable_under_view_of_view(self):
+        packed = rand_packed(np.random.default_rng(2))[0]
+        base = wl.canonical_digest(packed, 2, 3)
+        for v in wl.dihedral_views(packed):
+            for vv in wl.dihedral_views(v):
+                assert wl.canonical_digest(vv, 2, 3) == base
+
+    def test_bad_shape_is_typed(self):
+        with pytest.raises(ValueError):
+            wl.exact_digest(np.zeros((3, 19, 19), np.uint8), 1, 1)
+        with pytest.raises(ValueError):
+            wl.canonical_digest(np.zeros((9, 9, 9), np.uint8), 1, 1)
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+
+
+class TestRecorder:
+    def test_off_mode_is_free(self, tmp_path):
+        assert wl.note_request(np.zeros((9, 19, 19), np.uint8), 1, 1) is None
+        assert not wl.workload_enabled()
+        with make_engine("wl-off") as eng:
+            fut = eng.submit(rand_packed(np.random.default_rng(0))[0], 1, 5)
+            fut.result()
+        # nothing recorded, nothing written anywhere
+        assert wl.get_workload_recorder() is None
+
+    def test_engine_capture_end_to_end(self, tmp_path):
+        cap = str(tmp_path / "cap")
+        rec = wl.configure_workload(cap)
+        rng = np.random.default_rng(0)
+        boards = rand_packed(rng, 3)
+        with make_engine("wl-e2e") as eng:
+            futs = [eng.submit(boards[i % 3], 1, 5) for i in range(12)]
+            for f in futs:
+                f.result()
+        rec.drain()
+        stats = rec.stats()
+        assert stats["started"] == 12
+        assert stats["finished"] == 12
+        assert stats["dropped"] == 0
+        assert stats["unique"] == 3
+        assert stats["by_outcome"] == {"ok": 12}
+        wl.disable_workload()
+        report = wl.analyze_capture(cap)
+        assert report["requests"] == 12
+        assert report["unique"] == 3
+        assert report["dup_ratio"] == 0.75
+        assert report["projected_hit_rate"] == 0.75
+        assert report["replayable"] is True
+        assert report["positions_stored"] == 3
+        # the engine stamped the coalesced bucket on every record
+        assert set(report["buckets"]) <= {"1", "8"}
+        assert sum(report["buckets"].values()) == 12
+
+    def test_symmetry_duplicates_fold_onto_one_canonical_key(self, tmp_path):
+        cap = str(tmp_path / "cap")
+        rec = wl.configure_workload(cap)
+        packed = rand_packed(np.random.default_rng(3))[0]
+        views = wl.dihedral_views(packed)
+        with make_engine("wl-sym") as eng:
+            for v in views:
+                eng.submit(v, 1, 5).result()
+        rec.drain()
+        wl.disable_workload()
+        report = wl.analyze_capture(cap)
+        assert report["requests"] == 8
+        assert report["unique"] == 8             # 8 distinct exact inputs
+        assert report["canonical_unique"] == 1   # one orbit
+        assert report["symmetry_dedup_gain"] == 8.0
+        assert report["projected_hit_rate"] == 0.0
+        assert report["projected_hit_rate_canonical"] == 0.875
+
+    def test_one_record_per_request_through_the_full_stack(self, tmp_path):
+        # fleet -> supervisor -> engine: the fleet door owns the token;
+        # inner layers must not double-count
+        cap = str(tmp_path / "cap")
+        rec = wl.configure_workload(cap)
+        rng = np.random.default_rng(1)
+        boards = rand_packed(rng, 2)
+
+        def make_replica(i):
+            return SupervisedEngine(
+                lambda: InferenceEngine(
+                    ok_forward, None,
+                    EngineConfig(buckets=(1, 8), max_wait_ms=1.0),
+                    name=f"wl-fleet-{i}"),
+                name=f"wl-fleet-{i}")
+
+        with FleetRouter(make_replica, 2, name="wl-fleet") as fleet:
+            fleet.warmup()
+            futs = [fleet.submit(boards[i % 2], 1, 5,
+                                 tier=("interactive" if i % 2 else "batch"))
+                    for i in range(10)]
+            for f in futs:
+                f.result()
+        rec.drain()
+        stats = rec.stats()
+        wl.disable_workload()
+        assert stats["started"] == 10
+        assert stats["finished"] == 10
+        assert stats["by_tier"] == {"interactive": 5, "batch": 5}
+        report = wl.analyze_capture(cap)
+        assert report["requests"] == 10
+        assert report["tiers"] == {"batch": 5, "interactive": 5}
+
+    def test_requests_counter_labeled_by_tier(self, tmp_path):
+        from deepgo_tpu.obs import get_registry
+
+        before = {}
+        snap = get_registry().snapshot()["metrics"].get(
+            "deepgo_workload_requests_total")
+        if snap:
+            before = dict(snap["series"])
+        rec = wl.configure_workload(str(tmp_path / "cap"))
+        rec.note(np.zeros((9, 19, 19), np.uint8), 1, 1,
+                 tier="interactive").finish("ok")
+        rec.note(np.zeros((9, 19, 19), np.uint8), 1, 1).finish("ok")
+        rec.drain()
+        wl.disable_workload()
+        snap = get_registry().snapshot()["metrics"][
+            "deepgo_workload_requests_total"]["series"]
+        assert snap.get("tier=interactive", 0) \
+            - before.get("tier=interactive", 0) == 1
+        assert snap.get("tier=untiered", 0) \
+            - before.get("tier=untiered", 0) == 1
+
+    def test_outcome_classification(self, tmp_path):
+        rec = wl.configure_workload(str(tmp_path / "cap"))
+        from deepgo_tpu.serving import EngineOverloaded, PoisonedRequest
+
+        cases = [
+            (None, "ok"),
+            (TimeoutError("t"), "timeout"),
+            (EngineOverloaded("s"), "shed"),
+            (PoisonedRequest("p"), "poisoned"),
+            (RuntimeError("x"), "failed"),
+        ]
+        for exc, _expected in cases:
+            token = rec.note(np.zeros((9, 19, 19), np.uint8), 1, 1)
+            f = Future()
+            if exc is None:
+                f.set_result(1)
+            else:
+                f.set_exception(exc)
+            token.finish_future(f)
+        rec.drain()
+        stats = rec.stats()
+        wl.disable_workload()
+        assert stats["by_outcome"] == {"ok": 1, "timeout": 1, "shed": 1,
+                                       "poisoned": 1, "failed": 1}
+
+    def test_finish_is_idempotent(self, tmp_path):
+        rec = wl.configure_workload(str(tmp_path / "cap"))
+        token = rec.note(np.zeros((9, 19, 19), np.uint8), 1, 1)
+        token.finish("ok")
+        token.finish("failed")
+        rec.drain()
+        stats = rec.stats()
+        wl.disable_workload()
+        assert stats["finished"] == 1
+        assert stats["by_outcome"] == {"ok": 1}
+
+    def test_full_queue_drops_instead_of_blocking(self, tmp_path):
+        class SlowSink:
+            def write(self, kind, **fields):
+                time.sleep(0.05)
+
+            def close(self):
+                pass
+
+        rec = wl.WorkloadRecorder(SlowSink(), max_queue=2)
+        for _ in range(8):
+            token = rec.note(np.zeros((9, 19, 19), np.uint8), 1, 1)
+            token.finish("ok")
+        stats = rec.stats()
+        assert stats["dropped"] > 0
+        assert stats["dropped"] + stats["finished"] \
+            + stats["pending"] == 8
+        rec.close(timeout_s=2.0)
+
+    def test_capture_summary_record_on_close(self, tmp_path):
+        cap = str(tmp_path / "cap")
+        rec = wl.configure_workload(cap)
+        token = rec.note(np.zeros((9, 19, 19), np.uint8), 1, 1)
+        token.finish("ok")
+        wl.disable_workload()
+        loaded = wl.load_capture(cap)
+        assert loaded["summary"] is not None
+        assert loaded["summary"]["started"] == 1
+        assert loaded["summary"]["unique"] == 1
+
+
+# ---------------------------------------------------------------------------
+# capture reads
+
+
+class TestCaptureReads:
+    def _write_capture(self, cap, requests=6, uniques=2):
+        rng = np.random.default_rng(7)
+        boards = rand_packed(rng, uniques)
+        items = [{"t": 0.01 * i, "packed": boards[i % uniques],
+                  "player": 1, "rank": 5,
+                  "tier": ("interactive", "batch")[i % 2]}
+                 for i in range(requests)]
+        rp.write_synthetic_capture(cap, items)
+        return items
+
+    def test_torn_line_tolerated(self, tmp_path):
+        cap = str(tmp_path / "cap")
+        self._write_capture(cap)
+        # tear the request stream mid-record (a SIGKILLed recorder) and
+        # the position stream too
+        for name in ("workload.jsonl", "positions.jsonl"):
+            path = os.path.join(cap, name)
+            with open(path, "a") as f:
+                f.write('{"kind": "workload_requ')
+        report = wl.analyze_capture(cap)
+        assert report["requests"] == 6
+        assert report["unique"] == 2
+        assert report["replayable"] is True
+
+    def test_missing_capture_is_typed(self, tmp_path):
+        with pytest.raises(wl.WorkloadCaptureError):
+            wl.load_capture(str(tmp_path / "nope"))
+
+    def test_digest_only_capture_refuses_strict_replay(self, tmp_path):
+        cap = str(tmp_path / "cap")
+        self._write_capture(cap)
+        os.remove(os.path.join(cap, "positions.jsonl"))
+        with pytest.raises(wl.WorkloadCaptureError):
+            rp.load_trace(cap)
+        assert rp.load_trace(cap, strict=False) == []
+
+    def test_round_trip_payloads_bitwise(self, tmp_path):
+        cap = str(tmp_path / "cap")
+        items = self._write_capture(cap)
+        trace = rp.load_trace(cap)
+        assert len(trace) == len(items)
+        for got, want in zip(trace, items):
+            np.testing.assert_array_equal(got["packed"], want["packed"])
+            assert got["tier"] == want["tier"]
+
+
+# ---------------------------------------------------------------------------
+# the analyzer
+
+
+class TestAnalyzer:
+    def test_characterize_known_distribution(self):
+        # 10 requests over 3 canonical positions: 6/3/1
+        base = 1700000000.0
+        recs = []
+        for i, (d, n) in enumerate([("a", 6), ("b", 3), ("c", 1)]):
+            for j in range(n):
+                recs.append({"t": base + len(recs) * 0.1, "digest": d,
+                             "canonical": d, "tier": "interactive",
+                             "outcome": "ok"})
+        stats = wl.characterize(recs)
+        assert stats["requests"] == 10
+        assert stats["unique"] == 3
+        assert stats["canonical_unique"] == 3
+        assert stats["dup_ratio"] == 0.7
+        assert stats["projected_hit_rate"] == 0.7
+        assert stats["top_mass"]["1"] == 0.6
+        assert stats["zipf_exponent"] is not None
+        assert stats["interarrival"]["cv"] == 0.0        # metronome
+        assert stats["interarrival"]["burstiness"] == -1.0
+        assert stats["requests_per_sec"] == pytest.approx(10 / 0.9, rel=0.01)
+
+    def test_symmetry_gain_separates_exact_and_canonical(self):
+        recs = [{"t": i * 0.1, "digest": f"d{i}", "canonical": "same",
+                 "outcome": "ok"} for i in range(4)]
+        stats = wl.characterize(recs)
+        assert stats["unique"] == 4
+        assert stats["canonical_unique"] == 1
+        assert stats["symmetry_dedup_gain"] == 4.0
+        assert stats["projected_hit_rate"] == 0.0
+        assert stats["projected_hit_rate_canonical"] == 0.75
+
+    def test_empty_capture(self):
+        assert wl.characterize([]) == {"requests": 0}
+        assert "empty capture" in wl.format_workload({"requests": 0})
+
+    def test_format_renders_all_sections(self, tmp_path):
+        cap = str(tmp_path / "cap")
+        TestCaptureReads()._write_capture(cap)
+        text = wl.format_workload(wl.analyze_capture(cap))
+        for needle in ("projected cache hit rate", "popularity",
+                       "arrivals", "tiers", "replayable: True"):
+            assert needle in text
+
+
+# ---------------------------------------------------------------------------
+# replay
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += max(dt, 0.0)
+
+
+class _ScriptedEngine:
+    """Instant-resolve engine; records what it saw."""
+
+    def __init__(self, tiered=True):
+        self.seen = []
+        self.tiered = tiered
+
+    def submit(self, packed, player, rank, timeout_s=None, tier=None):
+        self.seen.append({"player": player, "rank": rank, "tier": tier})
+        f = Future()
+        f.set_result(np.float32(packed.sum()))
+        return f
+
+
+class TestReplay:
+    def _trace(self, n=20, gap=0.05):
+        rng = np.random.default_rng(5)
+        boards = rand_packed(rng, 4)
+        return [{"t": 100.0 + i * gap, "packed": boards[i % 4],
+                 "player": 1 + i % 2, "rank": 5,
+                 "tier": ("interactive", "selfplay", "batch")[i % 3]}
+                for i in range(n)]
+
+    def test_fake_clock_replay_is_exact(self):
+        clk = _FakeClock()
+        eng = _ScriptedEngine()
+        report = rp.WorkloadReplayer(eng, self._trace(), speed=2.0,
+                                     clock=clk, sleep=clk.sleep).run()
+        assert report["requests"] == 20
+        assert report["span_error_frac"] == 0.0
+        assert report["mean_lag_ms"] == 0.0
+        assert report["fidelity_ok"] is True
+        # tier mix reproduced exactly, and the engine saw the tiers
+        assert report["tiers"] == {"batch": 6, "interactive": 7,
+                                   "selfplay": 7}
+        assert [s["tier"] for s in eng.seen[:3]] \
+            == ["interactive", "selfplay", "batch"]
+        # recorded span 19*0.05 = 0.95s, replayed at 2x = 0.475s
+        assert report["target_span_s"] == pytest.approx(0.475)
+
+    def test_real_clock_fidelity_within_bar(self):
+        # generous gaps (25ms) so scheduler overhead sits far inside the
+        # 10% bar even on a loaded CI box
+        report = rp.WorkloadReplayer(_ScriptedEngine(),
+                                     self._trace(n=12, gap=0.025)).run()
+        assert report["fidelity_ok"] is True
+        assert report["span_error_frac"] <= 0.10
+        assert report["lag_frac"] <= 0.10
+
+    def test_untiered_target_still_served(self):
+        class NoTier:
+            def __init__(self):
+                self.n = 0
+
+            def submit(self, packed, player, rank, timeout_s=None):
+                self.n += 1
+                f = Future()
+                f.set_result(np.float32(0))
+                return f
+
+        eng = NoTier()
+        clk = _FakeClock()
+        report = rp.WorkloadReplayer(eng, self._trace(), clock=clk,
+                                     sleep=clk.sleep).run()
+        assert eng.n == 20
+        assert report["outcomes"] == {"ok": 20}
+
+    def test_shed_and_failed_outcomes_counted(self):
+        from deepgo_tpu.serving import EngineOverloaded
+
+        class Flaky:
+            def __init__(self):
+                self.n = 0
+
+            def submit(self, packed, player, rank, timeout_s=None,
+                       tier=None):
+                self.n += 1
+                if self.n % 3 == 0:
+                    raise EngineOverloaded("full")
+                f = Future()
+                if self.n % 3 == 1:
+                    f.set_result(np.float32(0))
+                else:
+                    f.set_exception(RuntimeError("boom"))
+                return f
+
+        clk = _FakeClock()
+        report = rp.WorkloadReplayer(Flaky(), self._trace(n=9), clock=clk,
+                                     sleep=clk.sleep).run()
+        assert report["outcomes"] == {"ok": 3, "shed": 3, "failed": 3}
+
+    def test_empty_and_bad_speed_typed(self):
+        with pytest.raises(ValueError):
+            rp.WorkloadReplayer(_ScriptedEngine(), [])
+        with pytest.raises(ValueError):
+            rp.WorkloadReplayer(_ScriptedEngine(), self._trace(), speed=0)
+
+
+# ---------------------------------------------------------------------------
+# the synthetic generator
+
+
+class TestSyntheticGenerator:
+    def test_deterministic_from_seed(self):
+        a = rp.build_synthetic_requests(SGF_DIR, requests=32, games=4,
+                                        opening_moves=6, seed=11)
+        b = rp.build_synthetic_requests(SGF_DIR, requests=32, games=4,
+                                        opening_moves=6, seed=11)
+        assert [x["t"] for x in a] == [y["t"] for y in b]
+        assert [x["tier"] for x in a] == [y["tier"] for y in b]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x["packed"], y["packed"])
+        c = rp.build_synthetic_requests(SGF_DIR, requests=32, games=4,
+                                        opening_moves=6, seed=12)
+        assert [x["t"] for x in a] != [z["t"] for z in c]
+
+    def test_opening_heavy_duplication(self, tmp_path):
+        items = rp.build_synthetic_requests(SGF_DIR, requests=128, games=8,
+                                            opening_moves=8, seed=0)
+        cap = str(tmp_path / "cap")
+        rp.write_synthetic_capture(cap, items)
+        stats = wl.analyze_capture(cap)
+        # the whole point: heavy duplication from the shared opening tree
+        assert stats["dup_ratio"] > 0.4
+        assert stats["projected_hit_rate"] > 0.4
+        assert stats["top_mass"]["1"] > 0.1   # the empty board dominates
+        assert stats["replayable"] is True
+        assert set(stats["tiers"]) == {"interactive", "selfplay", "batch"}
+
+    def test_missing_sgf_dir_typed(self, tmp_path):
+        with pytest.raises(wl.WorkloadCaptureError):
+            rp.build_synthetic_requests(str(tmp_path / "none"), requests=4)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: cli workload / cli obs / bench block
+
+
+class TestSurfaces:
+    def test_cli_workload_analyze(self, tmp_path, capsys):
+        from deepgo_tpu import cli
+
+        cap = str(tmp_path / "cap")
+        items = rp.build_synthetic_requests(SGF_DIR, requests=24, games=4,
+                                            opening_moves=4, seed=2)
+        rp.write_synthetic_capture(cap, items)
+        cli.main(["workload", "analyze", cap])
+        out = capsys.readouterr().out
+        assert "projected cache hit rate" in out
+        cli.main(["workload", "analyze", cap, "--json"])
+        data = json.loads(capsys.readouterr().out)
+        assert data["requests"] == 24
+        assert data["replayable"] is True
+
+    def test_cli_obs_workload_section(self, tmp_path, capsys):
+        from deepgo_tpu.obs.report import format_report, summarize_run
+
+        run = tmp_path / "run"
+        cap = str(run / "workload")
+        items = rp.build_synthetic_requests(SGF_DIR, requests=16, games=4,
+                                            opening_moves=4, seed=4)
+        rp.write_synthetic_capture(cap, items)
+        summary = summarize_run(str(run))
+        assert summary["workload"]["requests"] == 16
+        assert "projected_hit_rate" in summary["workload"]
+        text = format_report(summary)
+        assert "workload" in text
+        assert "projected cache hit rate" in text
+
+    def test_watchlist_carries_workload_counter(self):
+        from deepgo_tpu.obs.anomaly import DEFAULT_WATCHLIST
+
+        specs = {s.metric: s for s in DEFAULT_WATCHLIST}
+        assert "deepgo_workload_requests_total" in specs
+        assert specs["deepgo_workload_requests_total"].mode == "counter_rate"
+
+    @pytest.mark.slow
+    def test_cli_record_then_replay_live(self, tmp_path, capsys):
+        """The end-to-end witness: record a live fleet serving run,
+        analyze it, replay it — request count and tier mix exact,
+        timeline within the 10% bar."""
+        from deepgo_tpu import cli
+
+        cap = str(tmp_path / "cap")
+        cli.main(["workload", "record", "--out", cap, "--requests", "48",
+                  "--games", "4", "--opening-moves", "6", "--rate", "60",
+                  "--fleet", "2", "--sgf-dir", SGF_DIR, "--json"])
+        recorded = json.loads(capsys.readouterr().out)
+        assert recorded["workload"]["requests"] == 48
+        assert recorded["workload"]["replayable"] is True
+        assert recorded["workload"]["dup_ratio"] > 0.2
+        cli.main(["workload", "replay", cap, "--fleet", "2", "--json"])
+        replayed = json.loads(capsys.readouterr().out)
+        assert replayed["requests"] == 48
+        assert replayed["mix_match"] is True
+        assert replayed["tiers"] == recorded["workload"]["tiers"]
+        assert replayed["fidelity_ok"] is True
+        assert replayed["span_error_frac"] <= 0.10
