@@ -3,8 +3,8 @@
 .PHONY: native data test test-full lint verify verify-faults verify-serving \
     verify-resilience verify-fleet verify-distributed verify-obs \
     verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
-    verify-cost verify-quant verify-telemetry verify-workload bench \
-    bench-gate smoke clean
+    verify-cost verify-quant verify-telemetry verify-workload \
+    verify-chaos bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -73,7 +73,12 @@ verify-telemetry:  # fleet telemetry plane: fake-clock sampler cadence, retentio
 verify-workload:  # workload observatory: dihedral canonicalization, torn-line capture reads, off-mode-free recorder, open-loop replay fidelity, synthetic generator determinism, cli record/analyze/replay
 	JAX_PLATFORMS=cpu python -m pytest tests/test_workload.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload  # the full failure-model suite
+verify-chaos:  # chaos campaigns: fault-kind/scenario/hedging/ejection/canary suite, then a seeded kill+brownout+corrupt smoke campaign on a 2-replica CPU fleet over a synthetic opening-heavy trace (exit != 0 on a failed grade)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
+	JAX_PLATFORMS=cpu python -m deepgo_tpu.cli chaos run --preset full \
+	    --sgf-dir data/sgf/test --requests 120 --rate 40 --seed 0
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload verify-chaos  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -100,6 +100,7 @@ achieved_flops_per_s, mfu, bound} against the detected platform peak.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import sys
@@ -128,6 +129,7 @@ _METRIC_OF = {
     "serving": ("serving_engine_boards_per_sec_per_chip", "boards/sec"),
     "distributed": ("distributed_elastic_recovery_latency_s", "s"),
     "loop": ("loop_games_per_hour", "games/hour"),
+    "chaos": ("chaos_brownout_interactive_good_frac", "frac within SLO"),
 }
 
 
@@ -669,6 +671,12 @@ def _apply_gate(result: dict, args) -> None:
 
 
 def _exit_gate(result: dict, args) -> None:
+    # the chaos A/B verdict is unconditional: a broken defense (or a
+    # brownout the fleet shrugs off with defenses OFF — a toothless
+    # attack proves nothing) must fail the run even without --gate
+    chaos = result.get("chaos_gate")
+    if chaos is not None and not chaos.get("pass"):
+        raise SystemExit(1)
     if getattr(args, "gate", None) is None:
         return
     verdict = result.get("gate", {}).get("verdict")
@@ -1888,13 +1896,127 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     return result
 
 
+def _bench_chaos(on_tpu: bool, trace_capture: str | None = None,
+                 replay_speed: float = 1.0) -> dict:
+    """The chaos campaign gate (deepgo_tpu/chaos, docs/robustness.md):
+    three seeded campaigns over ONE opening-heavy trace, each against a
+    fresh 2-replica fleet.
+
+      acceptance    kill + brownout + output-corruption mid-trace with
+                    every defense armed — must complete with ZERO lost
+                    futures and ZERO wrong answers, the corrupt replica
+                    canary-detected and recycled
+      brownout ON   hedging + ejection armed — the interactive SLO must
+                    HOLD through the brownout (headroom spent, answers
+                    kept)
+      brownout OFF  same attack, defenses disarmed — the SLO must FAIL,
+                    proving the A/B: the defenses, not the fleet's
+                    slack, carry the verdict
+
+    The headline value is the ON arm's within-threshold fraction; the
+    `chaos` block carries all three reports' verdicts; `error` is set
+    (and the exit code nonzero) when any leg of the A/B breaks."""
+    import jax
+
+    from deepgo_tpu.chaos import (CampaignConfig, CampaignRunner,
+                                  acceptance_scenario, brownout_scenario,
+                                  defended_config)
+    from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.serving import (EngineConfig, FleetConfig,
+                                    SupervisorConfig, fleet_policy_engine)
+    from deepgo_tpu.serving import replay as replay_mod
+
+    cfg = policy_cnn.CONFIGS["small"]
+    params = policy_cnn.init(jax.random.key(0), cfg)
+    buckets = (1, 8, 32, 128) if on_tpu else (1, 8, 32)
+    ecfg = EngineConfig(buckets=buckets, max_wait_ms=2.0)
+    # no supervisor restarts: an injected dispatcher kill crosses into
+    # the FLEET failure domain (failover + respawn), same as --fleet
+    sup = SupervisorConfig(max_restarts=0, backoff_base_s=0.01,
+                           backoff_cap_s=0.05)
+    if trace_capture:
+        trace = replay_mod.load_trace(trace_capture)
+    else:
+        trace = replay_mod.build_synthetic_requests(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "data", "sgf", "test"),
+            requests=200, games=16, opening_moves=10, rate_per_s=45.0,
+            seed=11)
+    span_s = ((trace[-1]["t"] - trace[0]["t"]) / replay_speed
+              if len(trace) > 1 else 1.0)
+    camp_cfg = CampaignConfig(slo_threshold_s=0.15, slo_target=0.95,
+                              speed=replay_speed)
+    base = FleetConfig(respawn_base_s=0.01, respawn_cap_s=0.05)
+
+    def run_one(label: str, fleet_cfg, scenario, canary: bool) -> dict:
+        fleet = fleet_policy_engine(params, cfg, replicas=2, config=ecfg,
+                                    fleet=fleet_cfg, supervisor=sup,
+                                    name=label)
+        fleet.warmup()
+        try:
+            return CampaignRunner(
+                fleet, trace, scenario,
+                dataclasses.replace(camp_cfg, canary=canary)).run()
+        finally:
+            fleet.close()
+
+    runs = {
+        "acceptance": run_one(
+            "chaos-accept", defended_config(base),
+            acceptance_scenario(span_s), canary=True),
+        "brownout_on": run_one(
+            "chaos-on", defended_config(base),
+            brownout_scenario(span_s), canary=False),
+        "brownout_off": run_one(
+            "chaos-off", base, brownout_scenario(span_s), canary=False),
+    }
+
+    reasons = []
+    acc = runs["acceptance"]
+    if acc["answers"]["lost"]:
+        reasons.append(f"acceptance: {acc['answers']['lost']} lost "
+                       "future(s)")
+    if acc["answers"]["wrong"]:
+        reasons.append(f"acceptance: {acc['answers']['wrong']} wrong "
+                       "answer(s) returned")
+    if not (acc["canary"] or {}).get("detected"):
+        reasons.append("acceptance: corruption never canary-detected")
+    if not acc["counters"]["ejections"]:
+        reasons.append("acceptance: corrupt replica never recycled")
+    for label, want_ok in (("brownout_on", True), ("brownout_off", False)):
+        r = runs[label]
+        if r["answers"]["lost"] or r["answers"]["wrong"]:
+            reasons.append(f"{label}: integrity violated")
+        if bool(r["slo"]["ok"]) is not want_ok:
+            reasons.append(
+                f"{label}: SLO {'held' if r['slo']['ok'] else 'missed'} "
+                f"(bad_frac {r['slo']['bad_frac']}) — expected "
+                f"{'hold' if want_ok else 'miss'}")
+    metric, unit = _METRIC_OF["chaos"]
+    result = {
+        "bench": "chaos", "metric": metric, "unit": unit,
+        "value": runs["brownout_on"]["slo"]["good_frac"],
+        "trace": {"requests": len(trace), "span_s": round(span_s, 3),
+                  "source": trace_capture or "synthetic"},
+        "chaos": {label: {"slo": r["slo"], "answers": r["answers"],
+                          "counters": r["counters"],
+                          "canary": r["canary"],
+                          "grade": r["grade"]}
+                  for label, r in runs.items()},
+        "chaos_gate": {"pass": not reasons, "reasons": reasons},
+    }
+    if reasons:
+        result["error"] = "; ".join(reasons[:3])
+    return result
+
+
 def main() -> None:
     import argparse
 
     ap = argparse.ArgumentParser(description="deepgo_tpu benchmarks")
     ap.add_argument("--mode", default="inference",
                     choices=["inference", "train", "latency", "large",
-                             "serving", "distributed", "loop"])
+                             "serving", "distributed", "loop", "chaos"])
     ap.add_argument("--faults", nargs="?", const="__default__",
                     default=None, metavar="SPEC",
                     help="(--mode serving / distributed / loop) chaos run: "
@@ -1960,8 +2082,8 @@ def main() -> None:
         ap.error("--fleet only applies to --mode serving")
     if args.fleet is not None and args.fleet < 2:
         ap.error("--fleet needs N >= 2 (a 1-replica fleet is --faults)")
-    if args.trace is not None and args.mode != "serving":
-        ap.error("--trace only applies to --mode serving")
+    if args.trace is not None and args.mode not in ("serving", "chaos"):
+        ap.error("--trace only applies to --mode serving or chaos")
     if args.replay_speed <= 0:
         ap.error("--replay-speed must be > 0")
     if args.variant is not None:
@@ -2031,6 +2153,9 @@ def main() -> None:
                                     variant=args.variant,
                                     trace_capture=args.trace,
                                     replay_speed=args.replay_speed)
+        elif args.mode == "chaos":
+            result = _bench_chaos(on_tpu, trace_capture=args.trace,
+                                  replay_speed=args.replay_speed)
         elif args.mode == "loop":
             result = _bench_loop(on_tpu, args.faults)
         else:

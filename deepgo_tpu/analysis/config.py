@@ -25,7 +25,7 @@ import re
 PRAGMA_RE = re.compile(r"#\s*lint:\s*allow\[([a-z-]+)\]\s*(.*?)\s*$")
 
 RULES = ("atomic-write", "determinism", "thread-discipline",
-         "typed-error", "grammar-drift", "pragma",
+         "typed-error", "grammar-drift", "pragma", "bare-sleep",
          # the XLA performance-contract rules (ISSUE 11; the dynamic
          # half lives in analysis/xlacheck.py)
          "jit-boundary", "hot-sync", "donation", "constant-upload")
@@ -56,6 +56,16 @@ class LintConfig:
         "deepgo_tpu/data/dataset.py",
         "deepgo_tpu/experiments/checkpoint.py",
         "deepgo_tpu/loop/",
+        "deepgo_tpu/serving/",
+    )
+
+    # bare-sleep: serving code never calls time.sleep directly — a bare
+    # sleep in a dispatcher/router/supervisor thread is an invisible
+    # stall (no span, no fault site, not injectable under test). Delays
+    # there go through an injected sleep= hook or a waitable event;
+    # chaos brownouts go through utils/faults.maybe_slow (which owns
+    # the one legal sleep).
+    sleep_scope: tuple = (
         "deepgo_tpu/serving/",
     )
 

@@ -1,6 +1,6 @@
 """AST invariant linter (``cli lint`` / ``make lint``).
 
-Four per-file rules, each guarding a convention the system's headline
+Five per-file rules, each guarding a convention the system's headline
 guarantees rest on (docs/static_analysis.md has the full table):
 
   * ``atomic-write`` — durable artifacts go through ``utils/atomicio``:
@@ -19,6 +19,11 @@ guarantees rest on (docs/static_analysis.md has the full table):
     is either ``daemon=`` or joined somewhere in its module.
   * ``typed-error`` — no bare ``except:`` anywhere; no ``assert`` in the
     service layers (typed errors must survive ``python -O``).
+  * ``bare-sleep`` — no direct ``time.sleep`` in ``serving/``: a bare
+    sleep in a dispatcher/router thread is an invisible stall — no span,
+    no fault site, uninjectable under test. Delays go through an
+    injected ``sleep=`` hook or a waitable event; chaos brownouts go
+    through ``utils/faults.maybe_slow`` (the one legal sleep).
 
 Findings carry file:line, rule id, and a fix hint. A narrow pragma
 allowlist (``# lint: allow[RULE] reason`` — reason mandatory) admits
@@ -41,6 +46,8 @@ _HINTS = {
                          "or join() it",
     "typed-error": "raise a typed error (survives `python -O`); "
                    "catch specific exceptions",
+    "bare-sleep": "inject a sleep= hook / wait on an Event; brownout "
+                  "delays go through utils/faults.maybe_slow",
     "pragma": "pragmas need a reason: # lint: allow[RULE] why",
     "jit-boundary": "pass the state as an argument (or mark the scalar "
                     "static_argnames=); traced closures bake mutable "
@@ -104,6 +111,8 @@ class _FileChecker(ast.NodeVisitor):
         self._det = config.in_scope(rel, config.determinism_scope)
         self._assert = config.in_scope(rel, config.assert_scope)
         self._atomic = rel not in config.atomic_exempt
+        self._sleep = config.in_scope(rel, config.sleep_scope)
+        self._sleep_aliases: set[str] = set()  # from time import sleep [as x]
 
     def _add(self, rule: str, node, message: str) -> None:
         self.findings.append(Finding(rule, self.rel, node.lineno,
@@ -172,7 +181,30 @@ class _FileChecker(ast.NodeVisitor):
                       "thread is neither daemon= nor joined in this "
                       "module")
 
+    # -- bare-sleep --------------------------------------------------------
+
+    def _check_sleep(self, node: ast.Call, dotted: str) -> None:
+        # time.sleep(...) by attribute, or a from-import alias call.
+        # `sleep=time.sleep` default args are references, not calls, and
+        # an injected `sleep(...)` parameter is a Name the import scan
+        # never saw — both stay legal (that IS the prescribed fix).
+        bare = dotted == "time.sleep" or (
+            isinstance(node.func, ast.Name)
+            and node.func.id in self._sleep_aliases)
+        if bare:
+            self._add("bare-sleep", node,
+                      "direct time.sleep in serving code — an invisible "
+                      "stall with no span, no fault site, and no test "
+                      "injection point")
+
     # -- visitors ----------------------------------------------------------
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    self._sleep_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
         func = node.func
@@ -188,6 +220,8 @@ class _FileChecker(ast.NodeVisitor):
                 self._check_np_save(node, fn)
         if self._det and dotted:
             self._check_determinism(node, dotted)
+        if self._sleep:
+            self._check_sleep(node, dotted)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:

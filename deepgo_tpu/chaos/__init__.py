@@ -1,0 +1,46 @@
+"""Deterministic chaos campaigns for the serving fleet.
+
+A chaos campaign replays a captured (or synthetic) workload trace
+against a live fleet while a *scenario* — a declarative timeline of
+faults — browns out, kills, and corrupts replicas mid-trace, then
+grades the run against SLO burn objectives and integrity invariants:
+no lost futures, no wrong answers returned to callers, the
+latency-critical tier's budget holds. The defenses it validates
+(request hedging, latency-outlier ejection, canary integrity probes)
+live in serving/fleet.py; this package owns the attack and the grade.
+
+  scenario.py  FaultEvent / Scenario (JSON round-trip) and the
+               ScenarioScheduler thread that opens and closes fault
+               windows on the timeline via utils/faults add/remove
+  canary.py    CanaryProber — sentinel positions with known-good
+               answers probed against every replica; a wrong answer
+               ejects the replica through FleetRouter.eject_replica
+  campaign.py  CampaignRunner — ground truth, trace replay, grading,
+               and the JSON campaign report
+
+Operator surfaces: ``cli chaos run|report`` and ``bench.py --mode
+chaos`` (the hedging+ejection ON-vs-OFF A/B gate). docs/robustness.md
+"Chaos campaigns" specifies the scenario format and the grade.
+"""
+
+from .campaign import (CampaignConfig, CampaignRunner,
+                       acceptance_scenario, brownout_scenario,
+                       defended_config, grade_report, log_prob_integrity)
+from .canary import CanaryProber, make_sentinels
+from .scenario import EVENT_KINDS, FaultEvent, Scenario, ScenarioScheduler
+
+__all__ = [
+    "CampaignConfig",
+    "CampaignRunner",
+    "CanaryProber",
+    "EVENT_KINDS",
+    "FaultEvent",
+    "Scenario",
+    "ScenarioScheduler",
+    "acceptance_scenario",
+    "brownout_scenario",
+    "defended_config",
+    "grade_report",
+    "log_prob_integrity",
+    "make_sentinels",
+]

@@ -112,6 +112,16 @@ DEFAULT_WATCHLIST: tuple[WatchSpec, ...] = (
     WatchSpec("deepgo_serving_timeouts_total", mode="increase"),
     WatchSpec("deepgo_fleet_failovers_total", mode="increase"),
     WatchSpec("deepgo_fleet_respawns_total", mode="increase"),
+    # the gray-failure defenses (serving/fleet.py + deepgo_tpu/chaos):
+    # hedges ticking means a tail is being papered over — worth a look;
+    # an ejection or canary failure is a replica judged bad while still
+    # "healthy"; a breaker-state RISE (0 closed -> 1 half-open -> 2
+    # open) is a replica's supervisor cutting traffic
+    WatchSpec("deepgo_fleet_hedges_total", mode="increase"),
+    WatchSpec("deepgo_fleet_ejections_total", mode="increase"),
+    WatchSpec("deepgo_fleet_canary_failures_total", mode="increase"),
+    WatchSpec("deepgo_fleet_integrity_failures_total", mode="increase"),
+    WatchSpec("deepgo_fleet_breaker_state", mode="increase"),
     # per-replica, not the fleet total: a planned rolling reload dips
     # replicas_serving (drain is not an incident); a replica hitting the
     # FAILED state is one

@@ -42,7 +42,8 @@ from .replay import (WorkloadReplayer, build_synthetic_requests,  # noqa: F401
                      load_trace, write_synthetic_capture)
 from .supervisor import SupervisedEngine, SupervisorConfig  # noqa: F401
 from .fleet import (TIERS, FailoverExhausted, FleetConfig,  # noqa: F401
-                    FleetReloadError, FleetRouter, FleetUnavailable)
+                    FleetReloadError, FleetRouter, FleetUnavailable,
+                    IntegrityViolation)
 from .variants import VARIANTS, variant_spec, verify_variant  # noqa: F401
 
 

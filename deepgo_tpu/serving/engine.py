@@ -407,6 +407,13 @@ class InferenceEngine:
         t_fwd = time.monotonic()
         try:
             faults.check("serving_forward")
+            # gray-failure hooks (deepgo_tpu/chaos): an injected brownout
+            # sleeps INSIDE the timed dispatch window so the slowdown is
+            # visible to every latency surface (dispatch histogram,
+            # estimated_wait_s, the fleet's outlier ejection) exactly
+            # like a real slow replica; the sleep itself lives in the
+            # faults harness, not here
+            faults.maybe_slow("serving_slow", self.name)
             if self._xla_on:
                 # the DECLARED h2d point: stage explicitly so the armed
                 # transfer guard proves the guarded forward performs no
@@ -419,6 +426,12 @@ class InferenceEngine:
                 out = self._forward(self._params, packed, players, ranks)
             # lint: allow[hot-sync] dispatch-time d2h is the DECLARED materialization point: one fetch per coalesced batch (docs/static_analysis.md)
             out = np.asarray(out)
+            if faults.corrupt_due("serving_corrupt", self.name):
+                # silently WRONG output: sign-flipped and shifted, so a
+                # log-prob row comes back denormalized with its argmax
+                # at the original argmin — the gray failure the canary
+                # probes and the fleet integrity guard exist to catch
+                out = 1.0 - out
         except BaseException as e:  # noqa: BLE001 — typed onto the futures
             # contain the blast radius to THIS batch: its futures fail with
             # a typed wrapper (cause attached), the dispatcher keeps
@@ -500,6 +513,10 @@ class InferenceEngine:
                 # thread-death path (stashed error, failed futures, next
                 # submit() raises) that the supervisor's restart absorbs
                 faults.check("serving_dispatch")
+                # replica-scoped variant of the same death: a chaos
+                # scenario kills engine "bench-1" of a fleet by name
+                # while its peers keep serving (deepgo_tpu/chaos)
+                faults.check(f"serving_dispatch.{self.name}")
                 self._dispatch(batch)
         except BaseException as e:  # noqa: BLE001 — surfaced via submit()
             # AsyncLoader._worker's contract: stash the error, fail every

@@ -42,6 +42,26 @@ router, plus the two things a fleet needs that a single engine does not:
               deadline, interactive only when the deadline is genuinely
               unmeetable), with per-tier shed counters and a ``tier``
               label on the request-latency histogram.
+  hedging     gray-failure defense #1 (docs/robustness.md): a request on
+              a latency-critical tier (``hedge_tiers``) that has not
+              resolved after a p99-derived delay is DUPLICATED onto a
+              second replica — first result wins, the loser is
+              cancelled, and the duplicate rate is capped
+              (``hedge_max_frac``) so a sick fleet can't double its own
+              load. A slow-but-alive replica costs one hedge delay, not
+              one brownout.
+  ejection    gray-failure defense #2: the router keeps a per-replica
+              latency window; a replica whose median stays above
+              ``eject_factor`` x the median of its peers for
+              ``eject_consecutive`` scans is force-recycled through the
+              existing respawn path (``eject_replica`` — also the
+              entry point the canary prober uses when a replica starts
+              returning wrong answers, deepgo_tpu/chaos/canary.py).
+  integrity   gray-failure defense #3: an optional per-response
+              ``integrity_check`` predicate; a row that fails it is
+              treated as a replica failure (excluded, failed over,
+              counted) instead of being handed to the caller — corrupt
+              output becomes lost headroom, never a wrong answer.
 
 Fault sites: ``fleet_route`` fires inside each placement attempt (an
 injected fault there is absorbed like a replica failure — excluded,
@@ -59,13 +79,15 @@ drive every transition deterministically.
 from __future__ import annotations
 
 import dataclasses
+import heapq
+import itertools
 import os
 import queue
 import random
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
 import numpy as np
 
@@ -99,6 +121,13 @@ class FleetReloadError(EngineError):
     re-invoking ``reload`` is idempotent."""
 
 
+class IntegrityViolation(EngineError):
+    """A replica returned a response that failed the fleet's
+    ``integrity_check`` predicate — silently wrong output (the gray
+    failure). The router treats it as a replica failure: the request
+    fails over with exclusion and the caller never sees the bad row."""
+
+
 @dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Knobs for one FleetRouter.
@@ -110,7 +139,21 @@ class FleetConfig:
     first and the expensive tier last. ``max_failovers`` bounds how many
     replica FAILURES one request may ride through (shed-reroutes don't
     count); ``max_respawns`` bounds CONSECUTIVE background rebuilds of
-    one replica (any request it serves resets the count)."""
+    one replica (any request it serves resets the count).
+
+    The gray-failure knobs (docs/robustness.md, "Gray failures") are OFF
+    by default — ``hedge_tiers=()`` disables hedging,
+    ``eject_stragglers=False`` disables outlier ejection,
+    ``integrity_check=None`` disables response validation — so a plain
+    fleet behaves exactly as before; the chaos campaign's defenses-ON
+    arm (and any production config) opts in explicitly. A request on a
+    hedged tier duplicates after ``hedge_factor`` x that tier's rolling
+    p99 (floored at ``hedge_min_delay_s`` while the tier has no data),
+    with at most ``hedge_max_frac`` of submits hedged. A replica whose
+    per-replica latency median exceeds ``eject_factor`` x the median of
+    its peers (each over ``eject_min_samples``+ completions) for
+    ``eject_consecutive`` consecutive scans is force-recycled.
+    ``integrity_check(row) -> bool`` validates every response row."""
 
     max_failovers: int = 3
     default_tier: str = "interactive"
@@ -124,6 +167,15 @@ class FleetConfig:
     respawn_cap_s: float = 2.0
     warm_on_respawn: bool = True
     drain_timeout_s: float = 30.0
+    hedge_tiers: tuple = ()
+    hedge_factor: float = 1.0
+    hedge_min_delay_s: float = 0.02
+    hedge_max_frac: float = 0.2
+    eject_stragglers: bool = False
+    eject_factor: float = 3.0
+    eject_min_samples: int = 20
+    eject_consecutive: int = 2
+    integrity_check: object = None
 
     def headroom(self, tier: str) -> float:
         return {"interactive": self.interactive_headroom,
@@ -134,7 +186,8 @@ class FleetConfig:
 class _FleetRequest:
     __slots__ = ("packed", "player", "rank", "tier", "deadline", "future",
                  "excluded", "failovers", "t_submit", "t_first_failure",
-                 "last_error", "trace", "workload")
+                 "last_error", "trace", "workload", "placed", "inners",
+                 "hedge_state", "hedge_idx")
 
     def __init__(self, packed, player, rank, tier, deadline, t_submit,
                  trace=None, workload=None):
@@ -151,11 +204,15 @@ class _FleetRequest:
         self.last_error: BaseException | None = None
         self.trace = trace                # one id across every hop
         self.workload = workload          # WorkloadToken, fleet-owned
+        self.placed: int | None = None    # latest primary placement
+        self.inners: dict[int, Future] = {}  # replica idx -> inner future
+        self.hedge_state: str | None = None  # None|scheduled|launched
+        self.hedge_idx: int | None = None    # the hedge copy's replica
 
 
 class _Replica:
     __slots__ = ("idx", "engine", "state", "pending", "consec_respawns",
-                 "respawns")
+                 "respawns", "lat", "eject_strikes")
 
     def __init__(self, idx, engine):
         self.idx = idx
@@ -164,6 +221,8 @@ class _Replica:
         self.pending = 0         # in-flight requests routed here
         self.consec_respawns = 0
         self.respawns = 0
+        self.lat: deque = deque(maxlen=128)  # per-replica completion times
+        self.eject_strikes = 0   # consecutive outlier scans
 
 
 class FleetRouter:
@@ -211,6 +270,12 @@ class FleetRouter:
         self._respawns = 0
         self._reloads = 0
         self._poisoned = 0
+        self._submits = 0
+        self._hedges = 0
+        self._hedge_wins = 0
+        self._ejections = 0
+        self._integrity_failures = 0
+        self._respawn_threads: list[threading.Thread] = []
         self._shed = {t: 0 for t in TIERS}
         self._tier_lat: dict[str, deque] = {t: deque(maxlen=4096)
                                             for t in TIERS}
@@ -238,6 +303,21 @@ class FleetRouter:
             "deepgo_fleet_failover_seconds",
             "first replica failure to final resolution, failed-over "
             "requests only")
+        self._obs_hedges = reg.counter(
+            "deepgo_fleet_hedges_total",
+            "hedge duplicates launched for latency-critical tiers")
+        self._obs_hedge_wins = reg.counter(
+            "deepgo_fleet_hedge_wins_total",
+            "hedged requests whose hedge copy resolved first")
+        self._obs_ejections = reg.counter(
+            "deepgo_fleet_ejections_total",
+            "replicas force-recycled (latency outlier, canary, operator)")
+        self._obs_integrity = reg.counter(
+            "deepgo_fleet_integrity_failures_total",
+            "responses rejected by the fleet integrity check")
+        self._obs_breaker = reg.gauge(
+            "deepgo_fleet_breaker_state",
+            "per-replica circuit breaker: 0 closed, 1 half-open, 2 open")
         # the EXISTING request histogram gains a tier label at fleet
         # level: per-tier latency scrapes next to the engines' own series
         self._obs_request = reg.histogram(
@@ -246,6 +326,15 @@ class FleetRouter:
         self._replicas = [_Replica(i, make_replica(i))
                           for i in range(replicas)]
         self._update_serving_gauge()
+        self._hedge_q: list = []       # heap of (due, seq, request)
+        self._hedge_cv = threading.Condition()
+        self._hedge_seq = itertools.count()
+        self._hedge_thread = None
+        if self.config.hedge_tiers and replicas > 1:
+            self._hedge_thread = threading.Thread(
+                target=self._hedge_loop, name=f"fleet-{name}-hedger",
+                daemon=True)
+            self._hedge_thread.start()
         self._thread = threading.Thread(
             target=self._router_loop, name=f"fleet-{name}", daemon=True)
         self._thread.start()
@@ -291,10 +380,26 @@ class FleetRouter:
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop routing and shut every replica down. Same contract as the
         layers below: returns with every outstanding future resolved —
-        drained results or typed EngineClosed, never stranded waiters."""
+        drained results or typed EngineClosed, never stranded waiters.
+
+        Respawn threads are joined (bounded by ``timeout``) BEFORE the
+        replica engines close: a respawn that already built its
+        replacement engine swaps it in under the lock, and closing the
+        replica list while that swap is in flight would close the corpse
+        and leak the live replacement. ``_respawn`` checks ``_closing``
+        after the build and discards its engine, so after the join there
+        is exactly one engine per replica left to close."""
         self._closing.set()
         self._events.put(("stop", None))
+        with self._hedge_cv:
+            self._hedge_cv.notify_all()
         self._thread.join(timeout=timeout)
+        if self._hedge_thread is not None:
+            self._hedge_thread.join(timeout=timeout)
+        with self._lock:
+            spawners = list(self._respawn_threads)
+        for t in spawners:
+            t.join(timeout=timeout)
         for rep in self._replicas:
             try:
                 rep.engine.close(drain=drain, timeout=timeout)
@@ -360,6 +465,8 @@ class FleetRouter:
                                        fleet=self.name)
         req = _FleetRequest(np.asarray(packed), int(player), int(rank),
                             tier, deadline, now, trace=trace, workload=wl)
+        with self._lock:
+            self._submits += 1  # the hedge-rate cap's denominator
         if trace is not None:
             trace.mark("queued", fleet=self.name, tier=tier)
             req.future.add_done_callback(trace.finish_future)
@@ -438,7 +545,7 @@ class FleetRouter:
             if req.future.done():
                 return
             if req.deadline is not None and self._clock() >= req.deadline:
-                req.future.set_exception(TimeoutError(
+                self._resolve(req, exc=TimeoutError(
                     f"request deadline expired before placement in "
                     f"FleetRouter[{self.name}]"))
                 return
@@ -478,8 +585,14 @@ class FleetRouter:
                 continue
             with self._lock:
                 rep.pending += 1
+            req.placed = rep.idx
+            req.inners[rep.idx] = inner
             inner.add_done_callback(
                 lambda f, rep=rep: self._on_replica_done(req, rep, f))
+            if (self._hedge_thread is not None
+                    and req.tier in self.config.hedge_tiers
+                    and req.hedge_state is None):
+                self._schedule_hedge(req)
             return
 
     def _resolve_unroutable(self, req: _FleetRequest,
@@ -489,16 +602,16 @@ class FleetRouter:
         is simply down."""
         if shed_error is not None:
             self._count_shed(req.tier, "replicas")
-            req.future.set_exception(shed_error)
+            self._resolve(req, exc=shed_error)
         elif req.failovers > 0:
             err = FailoverExhausted(
                 f"FleetRouter[{self.name}] request failed over "
                 f"{req.failovers} time(s) and no healthy replica remains")
             err.__cause__ = req.last_error
-            req.future.set_exception(err)
+            self._resolve(req, exc=err)
         else:
             self._count_shed(req.tier, "unroutable")
-            req.future.set_exception(FleetUnavailable(
+            self._resolve(req, exc=FleetUnavailable(
                 f"FleetRouter[{self.name}] has no serving replica "
                 f"({self._serving_count()}/{len(self._replicas)} serving)"))
 
@@ -522,21 +635,75 @@ class FleetRouter:
                 f"FleetRouter[{self.name}] request exhausted its failover "
                 f"budget ({self.config.max_failovers}); last error: {exc!r}")
             err.__cause__ = exc
-            req.future.set_exception(err)
+            self._resolve(req, exc=err)
+
+    @staticmethod
+    def _resolve(req: _FleetRequest, value=None,
+                 exc: BaseException | None = None) -> bool:
+        """Resolve the caller's future exactly once. With hedging, the
+        primary and the hedge copy race to this point from different
+        resolver threads; the loser gets False and stands down."""
+        try:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.set_result(value)
+            return True
+        except InvalidStateError:
+            return False
+
+    @staticmethod
+    def _cancel_losers(req: _FleetRequest, winner_idx: int) -> None:
+        """Best-effort cancel of the losing placements of a resolved
+        request: a still-queued duplicate is withdrawn before dispatch
+        (``set_running_or_notify_cancel`` skips it); one already in a
+        forward just completes into a done caller-future and is
+        discarded on arrival."""
+        for idx, inner in list(req.inners.items()):
+            if idx != winner_idx and not inner.done():
+                inner.cancel()
 
     def _on_replica_done(self, req: _FleetRequest, rep: _Replica,
-                         f: Future) -> None:
+                         f: Future, hedge: bool = False) -> None:
         """Classify one replica completion. Runs on whatever thread
         resolved the replica future — never blocks, never submits;
-        failovers are handed to the router thread."""
+        failovers are handed to the router thread. With hedging a
+        request can complete twice: first result wins, the duplicate is
+        accounted (pending, per-replica latency) and dropped."""
         with self._lock:
             rep.pending -= 1
+        if f.cancelled():
+            return  # a withdrawn hedge loser; the winner already resolved
         exc = f.exception()
+        dt = self._clock() - req.t_submit
+        if exc is None:
+            # per-replica latency tap — winners AND hedge losers: the
+            # loser's slow completion is exactly the straggler signal
+            # the outlier ejection scan feeds on
+            with self._lock:
+                rep.lat.append(dt)
         if req.future.done():
             return
         if exc is None:
+            check = self.config.integrity_check
+            if check is not None and not self._integrity_ok(check, f):
+                with self._lock:
+                    self._integrity_failures += 1
+                self._obs_integrity.inc(fleet=self.name)
+                bad = IntegrityViolation(
+                    f"FleetRouter[{self.name}] replica {rep.idx} returned "
+                    "a response failing the integrity check; failing over")
+                self._note_failure(req, rep, bad)
+                self._failover_or_ride_hedge(req, rep)
+                return
             rep.consec_respawns = 0
-            dt = self._clock() - req.t_submit
+            if not self._resolve(req, value=f.result()):
+                return  # lost the hedge race after the done-check
+            if hedge:
+                with self._lock:
+                    self._hedge_wins += 1
+                self._obs_hedge_wins.inc(fleet=self.name)
+            self._cancel_losers(req, rep.idx)
             self._obs_request.observe(dt, engine=self.name, tier=req.tier)
             with self._lock:
                 self._tier_lat[req.tier].append(dt)
@@ -545,22 +712,135 @@ class FleetRouter:
                 self._obs_failover_s.observe(lat, fleet=self.name)
                 with self._lock:
                     self._failover_lat.append(lat)
-            req.future.set_result(f.result())
         elif isinstance(exc, TimeoutError):
             # the deadline is the request's own: final wherever it expired
-            req.future.set_exception(exc)
+            self._resolve(req, exc=exc)
         elif isinstance(exc, PoisonedRequest):
             # the request's content fails the forward — retrying it on
             # another replica would just poison the whole fleet in turn
             with self._lock:
                 self._poisoned += 1
-            req.future.set_exception(exc)
+            self._resolve(req, exc=exc)
         else:
             # replica died under the request (RestartsExhausted, closed,
             # or an unclassified engine error): failover with exclusion
             self._note_failure(req, rep, exc)
+            self._failover_or_ride_hedge(req, rep)
+
+    @staticmethod
+    def _integrity_ok(check, f: Future) -> bool:
+        try:
+            return bool(check(f.result()))
+        except Exception:  # noqa: BLE001 — a broken check must fail closed
+            return False
+
+    def _failover_or_ride_hedge(self, req: _FleetRequest,
+                                rep: _Replica) -> None:
+        """Queue a failover re-dispatch unless a sibling placement of
+        this request is still in flight — the hedge IS the retry. If
+        that sibling later fails too, its own completion callback sees
+        this placement done and queues the failover then; the last
+        sibling standing always either resolves the future or queues,
+        so no waiter strands."""
+        if req.future.done():
+            return
+        live = [i for idx, i in req.inners.items()
+                if idx != rep.idx and not i.done()]
+        if not live:
+            self._events.put(("failover", req))
+
+    # -- request hedging ---------------------------------------------------
+
+    def _hedge_delay_s(self, tier: str) -> float:
+        """The p99-derived hedge delay: duplicate only once the request
+        is already past what a HEALTHY replica's slowest percentile
+        would have taken — hedging the median request would double load
+        for nothing (the tail-at-scale rule). The bar is the fastest
+        serving replica's p99, not the pooled tier window: a browning
+        replica drags the pooled p99 up to its own latency, so a pooled
+        delay self-disables hedging exactly when it is needed (the
+        duplicate would fire only after the straggler already blew the
+        budget)."""
+        floor = self.config.hedge_min_delay_s
+        with self._lock:
+            windows = [np.array(rep.lat, dtype=np.float64)
+                       for rep in self._replicas
+                       if rep.state == "serving" and len(rep.lat) >= 16]
+            if not windows:
+                pooled = self._tier_lat[tier]
+                if len(pooled) < 16:
+                    return floor
+                windows = [np.array(pooled, dtype=np.float64)]
+        p99 = min(float(np.percentile(w, 99)) for w in windows)
+        return max(p99 * self.config.hedge_factor, floor)
+
+    def _schedule_hedge(self, req: _FleetRequest) -> None:
+        """Arm one hedge timer for a freshly placed request, subject to
+        the rate cap: at most ``hedge_max_frac`` of submits may hedge, so
+        a fleet-wide slowdown degrades into capped duplicate load
+        instead of a self-inflicted doubling."""
+        with self._lock:
+            over_cap = (self._hedges + 1
+                        > self.config.hedge_max_frac * max(self._submits, 1))
+        if over_cap:
+            return
+        req.hedge_state = "scheduled"
+        due = self._clock() + self._hedge_delay_s(req.tier)
+        with self._hedge_cv:
+            heapq.heappush(self._hedge_q, (due, next(self._hedge_seq), req))
+            self._hedge_cv.notify()
+
+    def _hedge_loop(self) -> None:
+        """The hedger thread: pops due timers; a request still
+        unresolved at its deadline gets a duplicate placement."""
+        while not self._closing.is_set():
+            with self._hedge_cv:
+                if not self._hedge_q:
+                    self._hedge_cv.wait(timeout=0.2)
+                    continue
+                due, _, req = self._hedge_q[0]
+                now = self._clock()
+                if due > now:
+                    self._hedge_cv.wait(timeout=min(due - now, 0.05))
+                    continue
+                heapq.heappop(self._hedge_q)
             if not req.future.done():
-                self._events.put(("failover", req))
+                self._launch_hedge(req)
+
+    def _launch_hedge(self, req: _FleetRequest) -> None:
+        """Place the duplicate on a second replica (primary excluded).
+        First result wins — ``_on_replica_done`` resolves exactly once
+        and cancels the loser. A hedge that cannot place (one replica
+        serving, replica full, closing) is silently dropped: hedging
+        only ever adds a chance, never a failure mode."""
+        if self._closing.is_set():
+            return
+        avoid = set() if req.placed is None else {req.placed}
+        rep = self._pick(req, avoid)
+        if rep is None or rep.idx == req.placed:
+            return
+        remaining = (None if req.deadline is None
+                     else req.deadline - self._clock())
+        if remaining is not None and remaining <= 0:
+            return
+        try:
+            kw = {} if req.trace is None else {"trace": req.trace}
+            inner = rep.engine.submit(req.packed, req.player, req.rank,
+                                      timeout_s=remaining, block=False, **kw)
+        except Exception:  # noqa: BLE001 — a failed hedge must stay silent
+            return
+        req.hedge_state = "launched"
+        req.hedge_idx = rep.idx
+        req.inners[rep.idx] = inner
+        with self._lock:
+            rep.pending += 1
+            self._hedges += 1
+        self._obs_hedges.inc(fleet=self.name, tier=req.tier)
+        if req.trace is not None:
+            req.trace.mark("hedged", replica=rep.idx)
+        inner.add_done_callback(
+            lambda f, rep=rep: self._on_replica_done(req, rep, f,
+                                                     hedge=True))
 
     # -- the router thread -------------------------------------------------
 
@@ -586,6 +866,9 @@ class FleetRouter:
     def _scan_replicas(self) -> None:
         for rep in self._replicas:
             self._check_replica(rep)
+        if self.config.eject_stragglers:
+            self._eject_outliers()
+        self._update_breaker_gauge()
 
     def _check_replica(self, rep: _Replica) -> None:
         with self._lock:
@@ -601,9 +884,102 @@ class FleetRouter:
                     return
                 rep.state = "respawning"
             self._update_serving_gauge()
-            threading.Thread(target=self._respawn, args=(rep,),
+            self._start_respawn(rep)
+
+    def _start_respawn(self, rep: _Replica) -> None:
+        """Spawn (and TRACK) one background respawn thread — close()
+        joins the tracked set so a shutdown racing an in-flight rebuild
+        neither hangs on it nor leaks its engine."""
+        t = threading.Thread(target=self._respawn, args=(rep,),
                              name=f"fleet-{self.name}-respawn-{rep.idx}",
-                             daemon=True).start()
+                             daemon=True)
+        with self._lock:
+            self._respawn_threads = [x for x in self._respawn_threads
+                                     if x.is_alive()]
+            self._respawn_threads.append(t)
+        t.start()
+
+    # -- gray-failure defenses: ejection + canary entry point --------------
+
+    def eject_replica(self, idx: int, reason: str = "operator") -> bool:
+        """Force one SERVING replica through the respawn path: placement
+        stops immediately, in-flight requests on it fail over as its
+        engine closes, and a fresh replica rejoins in the background.
+        The recycling half of the gray-failure story — the latency
+        outlier scan and the canary prober (deepgo_tpu/chaos/canary.py)
+        both land here. Returns False when the replica is not currently
+        serving (already draining/respawning/failed) or the fleet is
+        closing."""
+        if not 0 <= idx < len(self._replicas):
+            raise ValueError(f"replica {idx} not in fleet of "
+                             f"{len(self._replicas)}")
+        rep = self._replicas[idx]
+        if self._closing.is_set():
+            return False
+        with self._lock:
+            if rep.state != "serving":
+                return False
+            rep.state = "respawning"
+            rep.lat.clear()
+            rep.eject_strikes = 0
+            self._ejections += 1
+        self._update_serving_gauge()
+        self._obs_ejections.inc(fleet=self.name, reason=reason)
+        flight_dump("fleet_eject", fleet=self.name, replica=idx,
+                    why=reason)
+        if self._metrics is not None:
+            self._metrics.write("fleet_eject", fleet=self.name,
+                                replica=idx, reason=reason)
+        self._start_respawn(rep)
+        return True
+
+    def _eject_outliers(self) -> None:
+        """The straggler scan (router thread, idle ticks): a replica
+        whose median completion latency exceeds ``eject_factor`` x the
+        median of its PEERS — its own window excluded, so one straggler
+        can't drag the baseline up to its own level — for
+        ``eject_consecutive`` consecutive scans is recycled. Persistence
+        gating keeps one GC pause or one unlucky batch from costing a
+        respawn."""
+        cfg = self.config
+        with self._lock:
+            meds = {rep.idx: float(np.median(np.array(rep.lat)))
+                    for rep in self._replicas
+                    if rep.state == "serving"
+                    and len(rep.lat) >= cfg.eject_min_samples}
+        if len(meds) < 2:
+            return
+        for rep in self._replicas:
+            mine = meds.get(rep.idx)
+            if mine is None:
+                continue
+            peers = [v for k, v in meds.items() if k != rep.idx]
+            base = float(np.median(np.array(peers)))
+            if base > 0.0 and mine > cfg.eject_factor * base:
+                rep.eject_strikes += 1
+                if rep.eject_strikes >= cfg.eject_consecutive:
+                    self.eject_replica(rep.idx, reason="straggler")
+            else:
+                rep.eject_strikes = 0
+
+    _BREAKER_VALUE = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+    def _update_breaker_gauge(self) -> None:
+        """Republish each replica's CircuitBreaker.snapshot() as the
+        ``deepgo_fleet_breaker_state`` gauge (0 closed / 1 half-open /
+        2 open) so breaker flaps reach the watchlist and dash, not just
+        health()."""
+        for rep in self._replicas:
+            snap_fn = getattr(rep.engine, "breaker_snapshot", None)
+            if snap_fn is None:
+                continue
+            try:
+                state = (snap_fn() or {}).get("state")
+            except Exception:  # noqa: BLE001 — a corpse mid-respawn
+                continue
+            self._obs_breaker.set(
+                self._BREAKER_VALUE.get(state, 0.0),
+                fleet=self.name, replica=str(rep.idx))
 
     def _respawn(self, rep: _Replica) -> None:
         """Background rebuild of one dead replica: bounded consecutive
@@ -622,7 +998,10 @@ class FleetRouter:
                         "fleet_replica_failed", fleet=self.name,
                         replica=rep.idx, respawns=rep.respawns)
                 return
-            self._sleep(full_jitter_delay(
+            # backoff waits on the closing event, not a bare sleep, so a
+            # concurrent close() interrupts the wait instead of hanging
+            # its join on a full backoff cap
+            self._closing.wait(full_jitter_delay(
                 rep.consec_respawns - 1, self.config.respawn_base_s,
                 self.config.respawn_cap_s, self._rng))
             try:
@@ -647,6 +1026,8 @@ class FleetRouter:
                 rep.engine = eng
                 rep.state = "serving"
                 rep.respawns += 1
+                rep.lat.clear()       # a fresh engine starts a fresh window
+                rep.eject_strikes = 0
                 self._respawns += 1
                 total = self._respawns
             self._update_serving_gauge()
@@ -779,8 +1160,21 @@ class FleetRouter:
                 "respawns": self._respawns,
                 "reloads": self._reloads,
                 "poisoned": self._poisoned,
+                "hedges": self._hedges,
+                "hedge_wins": self._hedge_wins,
+                "ejections": self._ejections,
+                "integrity_failures": self._integrity_failures,
                 "shed": dict(self._shed),
             }
+
+    def probe_targets(self) -> list:
+        """(idx, engine) for every SERVING replica — the canary prober's
+        placement-pinned view (deepgo_tpu/chaos/canary.py submits its
+        sentinels directly to each engine, bypassing placement, so a
+        corrupt replica can't hide behind a healthy peer)."""
+        with self._lock:
+            return [(r.idx, r.engine) for r in self._replicas
+                    if r.state == "serving"]
 
     def _tier_latency(self) -> dict:
         out = {}

@@ -90,6 +90,7 @@ class WorkloadReplayer:
 
     def __init__(self, engine, trace: list[dict], speed: float = 1.0,
                  timeout_s: float | None = None,
+                 collect_timeout_s: float = 60.0, on_result=None,
                  clock=time.monotonic, sleep=time.sleep):
         if speed <= 0:
             raise ValueError(f"speed must be > 0, got {speed}")
@@ -99,6 +100,11 @@ class WorkloadReplayer:
         self.trace = sorted(trace, key=lambda r: float(r.get("t", 0.0)))
         self.speed = float(speed)
         self.timeout_s = timeout_s
+        self.collect_timeout_s = float(collect_timeout_s)
+        # per-request observer: on_result(item, outcome, value, exc) —
+        # the chaos campaign's wrong-answer and lost-future accounting
+        # rides here instead of a second pass over private state
+        self.on_result = on_result
         self._clock = clock
         self._sleep = sleep
         self._accepts_tier = "tier" in inspect.signature(
@@ -110,7 +116,10 @@ class WorkloadReplayer:
                    for r in self.trace]
         actuals: list[float] = []
         futures: list = []
-        outcomes = {o: 0 for o in workload_mod.OUTCOMES}
+        # "lost" extends the capture-outcome vocabulary for replay only:
+        # a future nobody resolved within collect_timeout_s — the
+        # integrity invariant chaos campaigns exist to check
+        outcomes = {o: 0 for o in (*workload_mod.OUTCOMES, "lost")}
         tiers: dict[str, int] = {}
         t0 = self._clock()
         for item, target in zip(self.trace, targets):
@@ -128,24 +137,33 @@ class WorkloadReplayer:
                     timeout_s=self.timeout_s, **kw))
             except (EngineOverloaded, CircuitOpen, EngineBusy,
                     FleetUnavailable):
-                outcomes["shed"] += 1
-                futures.append(None)
+                futures.append(None)  # counted as shed at collection
             actuals.append(self._clock() - t0)
-        for f in futures:
+        for item, f in zip(self.trace, futures):
+            value = exc = None
             if f is None:
-                continue
-            try:
-                f.result(timeout=60.0)
-                outcomes["ok"] += 1
-            except TimeoutError:
-                outcomes["timeout"] += 1
-            except (EngineOverloaded, CircuitOpen, EngineBusy,
-                    FleetUnavailable):
-                outcomes["shed"] += 1
-            except PoisonedRequest:
-                outcomes["poisoned"] += 1
-            except BaseException:  # noqa: BLE001 — an outcome, not a crash
-                outcomes["failed"] += 1
+                outcome = "shed"
+            else:
+                try:
+                    value = f.result(timeout=self.collect_timeout_s)
+                    outcome = "ok"
+                except TimeoutError as e:
+                    # a future STILL unresolved after the collection
+                    # grace is lost — dropped by a failover hole, not
+                    # merely late; a resolved TimeoutError is a
+                    # deadline verdict the serving side delivered
+                    outcome = "lost" if not f.done() else "timeout"
+                    exc = e
+                except (EngineOverloaded, CircuitOpen, EngineBusy,
+                        FleetUnavailable) as e:
+                    outcome, exc = "shed", e
+                except PoisonedRequest as e:
+                    outcome, exc = "poisoned", e
+                except BaseException as e:  # noqa: BLE001 — an outcome
+                    outcome, exc = "failed", e
+            outcomes[outcome] += 1
+            if self.on_result is not None:
+                self.on_result(item, outcome, value, exc)
         wall = self._clock() - t0
         target_span = targets[-1]
         actual_span = actuals[-1] - actuals[0] if len(actuals) > 1 else 0.0

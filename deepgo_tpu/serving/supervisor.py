@@ -575,6 +575,13 @@ class SupervisedEngine:
                 "quarantined": list(self._quarantined),
             }
 
+    def breaker_snapshot(self) -> dict:
+        """The circuit breaker's state dict (state / consecutive_failures
+        / transitions) — the fleet router republishes it per replica as
+        the ``deepgo_fleet_breaker_state`` gauge, so breaker flaps are
+        telemetry, not just a ``health()`` field."""
+        return self._breaker.snapshot()
+
     def health(self) -> dict:
         """One snapshot of the whole resilience layer: supervisor state,
         breaker state, restart/shed/poison counters, the load estimate,

@@ -61,9 +61,28 @@ Grammar (comma-separated ``site:kind@arg`` specs):
   step@K       (kill site only) SIGKILL this process once the training
                step counter reaches K: no cleanup, no atexit, the honest
                preemption
+  slow@MS      GRAY failure: every hit of the site sleeps MS milliseconds
+               while the spec is installed — a brownout, not a crash. The
+               site stays alive and "healthy"; only its latency lies.
+               Consulted through ``maybe_slow``, never raised.
+  corrupt@N    GRAY failure: the first N hits return silently WRONG
+               output — the site must ask ``corrupt_due`` and perturb its
+               own result. Nothing raises; the corruption is only
+               detectable by checking answers (the canary-probe path).
+
+Gray kinds (slow/corrupt) are value-consulted, not raise-based:
+``check()`` ignores them entirely, so a site that only calls ``check``
+never pays for — or trips over — a gray spec aimed elsewhere. Sites
+that support gray faults consult ``maybe_slow(site, name)`` /
+``corrupt_due(site, name)``, which also match the replica-scoped form
+``site.<name>`` — ``serving_slow.bench-1:slow@40`` brownouts exactly
+one replica of a fleet while its peers stay fast.
 
 The plan is process-local mutable state on purpose: counters advance as
 sites are hit, which is what makes "fail the 2nd write" expressible.
+``add``/``remove`` mutate the installed plan in place, which is what
+lets a chaos scenario schedule (deepgo_tpu/chaos) open and close fault
+windows on a timeline instead of arming everything at t=0.
 """
 
 from __future__ import annotations
@@ -71,6 +90,7 @@ from __future__ import annotations
 import os
 import signal
 import sys
+import time
 from dataclasses import dataclass, field
 
 
@@ -89,7 +109,10 @@ class TransientFault(FaultError, OSError):
     exactly like a real transient I/O error."""
 
 
-_KINDS = ("fail", "transient", "step")
+_KINDS = ("fail", "transient", "step", "slow", "corrupt")
+
+# the raise/kill kinds check() owns; slow/corrupt are value-consulted
+_CHECK_KINDS = ("fail", "transient", "step")
 
 
 @dataclass
@@ -141,10 +164,52 @@ class FaultPlan:
             specs.append(FaultSpec(site, kind, arg_n))
         return cls(specs)
 
-    def check(self, site: str, step: int | None = None) -> None:
-        """Advance counters for ``site``; raise / kill if a spec is due."""
+    def add(self, text: str) -> list[FaultSpec]:
+        """Parse ``text`` and merge its specs into this plan (counters of
+        existing specs untouched). Returns the specs added."""
+        added = FaultPlan.parse(text).specs
+        self.specs.extend(added)
+        return added
+
+    def remove(self, site: str, kind: str | None = None) -> int:
+        """Drop every spec at ``site`` (optionally only of ``kind``);
+        returns how many were removed. Closing a chaos fault window."""
+        keep, dropped = [], 0
         for spec in self.specs:
-            if spec.site != site:
+            if spec.site == site and (kind is None or spec.kind == kind):
+                dropped += 1
+            else:
+                keep.append(spec)
+        self.specs[:] = keep
+        return dropped
+
+    def slow_s(self, site: str) -> float:
+        """Total injected delay (seconds) due at this hit of ``site`` —
+        0.0 when no slow spec matches. Advances slow hit counters."""
+        total = 0.0
+        for spec in self.specs:
+            if spec.kind == "slow" and spec.site == site:
+                spec.hits += 1
+                total += spec.arg / 1000.0
+        return total
+
+    def corrupt_hit(self, site: str) -> bool:
+        """True when a corrupt spec at ``site`` still owes corruption
+        (first N hits). Advances corrupt hit counters."""
+        due = False
+        for spec in self.specs:
+            if spec.kind == "corrupt" and spec.site == site:
+                spec.hits += 1
+                if spec.hits <= spec.arg:
+                    due = True
+        return due
+
+    def check(self, site: str, step: int | None = None) -> None:
+        """Advance counters for ``site``; raise / kill if a spec is due.
+        Gray kinds (slow/corrupt) are ignored here — they are consulted
+        by value through ``maybe_slow`` / ``corrupt_due``."""
+        for spec in self.specs:
+            if spec.site != site or spec.kind not in _CHECK_KINDS:
                 continue
             if spec.kind == "step":
                 if step is None or spec.fired:
@@ -202,3 +267,45 @@ def check(site: str, step: int | None = None) -> None:
     plan = active_plan()
     if plan:
         plan.check(site, step)
+
+
+def add(text: str) -> list[FaultSpec]:
+    """Merge specs into the active plan (chaos scenario windows)."""
+    return active_plan().add(text)
+
+
+def remove(site: str, kind: str | None = None) -> int:
+    """Remove specs at ``site`` from the active plan."""
+    plan = _plan
+    return plan.remove(site, kind) if plan is not None else 0
+
+
+def maybe_slow(site: str, name: str | None = None,
+               sleep=time.sleep) -> float:
+    """Gray-failure hook: sleep any injected brownout delay due at
+    ``site`` (and, when ``name`` is given, at the replica-scoped site
+    ``site.name``); returns the seconds slept. The sleep happens HERE,
+    inside the faults harness, so serving code never needs a bare
+    time.sleep for injection (the bare-sleep lint rule)."""
+    plan = active_plan()
+    if not plan:
+        return 0.0
+    delay = plan.slow_s(site)
+    if name is not None:
+        delay += plan.slow_s(f"{site}.{name}")
+    if delay > 0.0:
+        sleep(delay)
+    return delay
+
+
+def corrupt_due(site: str, name: str | None = None) -> bool:
+    """Gray-failure hook: True when this hit of ``site`` (or of the
+    replica-scoped ``site.name``) must return a corrupted result. The
+    call site owns the perturbation; this only answers "is it due"."""
+    plan = active_plan()
+    if not plan:
+        return False
+    due = plan.corrupt_hit(site)
+    if name is not None:
+        due = plan.corrupt_hit(f"{site}.{name}") or due
+    return due

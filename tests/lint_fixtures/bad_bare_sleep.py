@@ -1,0 +1,21 @@
+"""Known-bad fixture for the bare-sleep rule (explicit-path mode puts
+this file in scope). Lines pinned by tests/test_analysis.py."""
+import time
+from time import sleep
+
+
+def backoff():
+    time.sleep(0.1)  # line 8: bare sleep — invisible stall, uninjectable
+
+
+def imported():
+    sleep(0.05)  # line 12: from-import does not dodge the rule
+
+
+def declared():
+    # lint: allow[bare-sleep] fixture: the reasoned pragma path
+    time.sleep(0.01)
+
+
+def injectable(wait=time.sleep):
+    wait(0.02)  # injected sleep hook: the prescribed fix, not a finding

@@ -113,6 +113,13 @@ def test_constant_upload_fixture():
     ]  # factory-scope hoist / lowercase locals / pragma are NOT here
 
 
+def test_bare_sleep_fixture():
+    assert keyed(fixture_findings("bad_bare_sleep.py")) == [
+        ("bare-sleep", 8),   # time.sleep by attribute
+        ("bare-sleep", 12),  # from-import sleep() call
+    ]  # the pragma'd call and the injected wait= hook are NOT here
+
+
 def test_clean_fixture_has_no_findings():
     assert fixture_findings("clean_ok.py") == []
 

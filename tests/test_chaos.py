@@ -1,0 +1,484 @@
+"""Chaos campaigns and the gray-failure defenses (deepgo_tpu/chaos/,
+serving/fleet.py hedging / ejection / integrity, utils/faults slow+corrupt).
+
+The load-bearing contracts:
+
+  * the ``slow`` / ``corrupt`` fault kinds are replica-scoped and
+    deterministic: a brownout window sleeps inside the faults harness
+    (never a bare ``time.sleep`` in serving code), a corruption budget
+    counts down per dispatched batch;
+  * a ``Scenario`` round-trips through JSON (a campaign is reproducible
+    from its report alone) and the ``ScenarioScheduler`` opens fault
+    windows on the timeline and ALWAYS sweeps them shut on ``stop()``;
+  * request hedging duplicates a latency-critical request onto a second
+    replica after the p99-derived delay — first result wins, the rate
+    cap bounds duplicate load, non-hedged tiers never hedge;
+  * a browned-out replica is ejected by the latency-outlier scan and a
+    corrupt replica by the canary prober — both recycle through the
+    standard respawn path and the fleet keeps answering correctly;
+  * the per-response integrity check turns silent corruption into a
+    failover: callers get right answers, the counter records the saves;
+  * a full ``CampaignRunner`` run under a brownout (and under
+    corruption with canaries armed) grades PASS: zero lost futures,
+    zero wrong answers, detection when corruption was injected.
+"""
+
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepgo_tpu.chaos import (CampaignConfig, CampaignRunner, CanaryProber,
+                              FaultEvent, Scenario, ScenarioScheduler,
+                              acceptance_scenario, brownout_scenario,
+                              defended_config, grade_report,
+                              log_prob_integrity, make_sentinels)
+from deepgo_tpu.serving import (EngineConfig, FleetConfig, FleetRouter,
+                                InferenceEngine, SupervisedEngine,
+                                SupervisorConfig)
+from deepgo_tpu.utils import faults
+
+ECFG = EngineConfig(buckets=(1, 4), max_wait_ms=0.0)
+DIE_FAST = SupervisorConfig(max_restarts=0, backoff_base_s=0.001,
+                            backoff_cap_s=0.005)
+FAST_FLEET = FleetConfig(respawn_base_s=0.001, respawn_cap_s=0.005)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def lp_forward(params, packed, player, rank):
+    """Log-prob-shaped scripted forward: strictly negative, distinct per
+    board — passes ``log_prob_integrity`` until the corrupt hook flips
+    it positive."""
+    return -(np.asarray(packed, np.float32).sum(axis=(1, 2, 3))
+             + 1000.0 * np.asarray(player, np.float32) + 1.0)
+
+
+def make_fleet(name, forward=lp_forward, replicas=2,
+               fleet_config=FAST_FLEET, sup_config=DIE_FAST,
+               engine_config=ECFG, **kw):
+    """Replicas named ``{name}-{i}`` — the ScenarioScheduler's default
+    index->engine-name map, so scenario events land on these engines."""
+    def make_replica(i):
+        return SupervisedEngine(
+            lambda: InferenceEngine(forward, None, engine_config,
+                                    name=f"{name}-{i}"),
+            config=sup_config, name=f"{name}-{i}")
+
+    kw.setdefault("rng", random.Random(0))
+    return FleetRouter(make_replica, replicas, config=fleet_config,
+                       name=name, **kw)
+
+
+def make_trace(n=30, rate=60.0, tier="interactive", seed=0):
+    rng = np.random.default_rng(seed)
+    items, t = [], 0.0
+    for _ in range(n):
+        t += 1.0 / rate
+        items.append({
+            "t": t,
+            "packed": rng.integers(0, 3, size=(9, 19, 19), dtype=np.uint8),
+            "player": int(rng.integers(1, 3)),
+            "rank": int(rng.integers(1, 10)),
+            "tier": tier,
+        })
+    return items
+
+
+def wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def no_sleep(_):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# the fault grammar: slow + corrupt kinds
+
+
+class TestFaultKinds:
+    def test_slow_sleeps_inside_the_harness(self):
+        faults.add("serving_slow.x:slow@50")
+        slept = []
+        dt = faults.maybe_slow("serving_slow", "x", sleep=slept.append)
+        assert dt == pytest.approx(0.05)
+        assert slept == [pytest.approx(0.05)]
+        # a different replica's window does not leak across names
+        assert faults.maybe_slow("serving_slow", "y",
+                                 sleep=no_sleep) == 0.0
+
+    def test_slow_site_and_replica_scopes_sum(self):
+        faults.add("serving_slow:slow@20")
+        faults.add("serving_slow.x:slow@30")
+        dt = faults.maybe_slow("serving_slow", "x", sleep=no_sleep)
+        assert dt == pytest.approx(0.05)
+
+    def test_slow_window_closes_on_remove(self):
+        faults.add("serving_slow.x:slow@50")
+        assert faults.maybe_slow("serving_slow", "x",
+                                 sleep=no_sleep) > 0.0
+        faults.remove("serving_slow.x", "slow")
+        assert faults.maybe_slow("serving_slow", "x",
+                                 sleep=no_sleep) == 0.0
+
+    def test_corrupt_budget_counts_down(self):
+        faults.add("serving_corrupt.x:corrupt@2")
+        assert faults.corrupt_due("serving_corrupt", "x")
+        assert faults.corrupt_due("serving_corrupt", "x")
+        assert not faults.corrupt_due("serving_corrupt", "x")
+        assert not faults.corrupt_due("serving_corrupt", "y")
+
+
+# ---------------------------------------------------------------------------
+# scenarios: validation, JSON round-trip, the scheduler thread
+
+
+class TestScenario:
+    def test_event_validation(self):
+        with pytest.raises(ValueError):
+            FaultEvent(at_s=0.0, kind="meteor")
+        with pytest.raises(ValueError):
+            FaultEvent(at_s=-1.0, kind="kill")
+        with pytest.raises(ValueError):  # unbounded brownout
+            FaultEvent(at_s=0.0, kind="slow", duration_s=0.0)
+        with pytest.raises(ValueError):
+            FaultEvent(at_s=0.0, kind="corrupt", arg=0)
+
+    def test_json_round_trip(self):
+        sc = Scenario(name="rt", seed=7, events=(
+            FaultEvent(at_s=0.1, kind="slow", replica=0,
+                       duration_s=0.5, arg=120),
+            FaultEvent(at_s=0.2, kind="corrupt", replica=1, arg=9),
+            FaultEvent(at_s=0.3, kind="kill", replica=0),
+            FaultEvent(at_s=0.4, kind="saturate", arg=32),
+        ))
+        back = Scenario.from_dict(json.loads(json.dumps(sc.to_dict())))
+        assert back == sc
+        assert back.span_s() == pytest.approx(0.6)
+
+    def test_presets_scale_to_span(self):
+        b = brownout_scenario(span_s=10.0, brownout_ms=150)
+        assert len(b.events) == 1 and b.events[0].kind == "slow"
+        assert b.events[0].duration_s == pytest.approx(8.8)
+        a = acceptance_scenario(span_s=10.0)
+        assert {e.kind for e in a.events} == {"slow", "corrupt", "kill"}
+
+    def test_scheduler_opens_windows_and_sweeps_on_stop(self):
+        sc = Scenario(name="sweep", events=(
+            FaultEvent(at_s=0.0, kind="slow", replica=0,
+                       duration_s=30.0, arg=40),
+            FaultEvent(at_s=0.0, kind="corrupt", replica=1, arg=100),
+            FaultEvent(at_s=0.0, kind="kill", replica=0),
+        ))
+        sched = ScenarioScheduler(sc, fleet_name="swp")
+        sched.start()
+        assert wait_until(lambda: len(sched.executed) >= 3)
+        # the brownout window is open, replica-scoped
+        assert faults.maybe_slow("serving_slow", "swp-0",
+                                 sleep=no_sleep) == pytest.approx(0.04)
+        assert faults.corrupt_due("serving_corrupt", "swp-1")
+        with pytest.raises(faults.FaultError):
+            faults.check("serving_dispatch.swp-0")
+        sched.stop()
+        # stop() swept the open windows shut — chaos never outlives
+        # its campaign
+        assert faults.maybe_slow("serving_slow", "swp-0",
+                                 sleep=no_sleep) == 0.0
+        assert not faults.corrupt_due("serving_corrupt", "swp-1")
+        phases = [(e["kind"], e["phase"]) for e in sched.executed]
+        assert ("slow", "open") in phases and ("kill", "open") in phases
+
+    def test_scheduler_saturate_calls_burst_hook(self):
+        bursts = []
+        sc = Scenario(name="sat", events=(
+            FaultEvent(at_s=0.0, kind="saturate", arg=7),))
+        sched = ScenarioScheduler(sc, fleet_name="sat",
+                                  submit_burst=bursts.append)
+        sched.start()
+        assert wait_until(lambda: bursts == [7])
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# request hedging
+
+
+class TestHedging:
+    def test_hedge_fires_and_first_result_wins(self):
+        cfg = FleetConfig(
+            respawn_base_s=0.001, respawn_cap_s=0.005,
+            hedge_tiers=("interactive",), hedge_min_delay_s=0.01,
+            hedge_max_frac=1.0)
+        fleet = make_fleet("hedge1", fleet_config=cfg)
+        try:
+            faults.add("serving_slow.hedge1-0:slow@400")
+            trace = make_trace(8, rate=200.0, seed=1)
+            t0 = time.monotonic()
+            futs = [fleet.submit(it["packed"], it["player"], it["rank"],
+                                 tier="interactive") for it in trace]
+            got = [np.atleast_1d(f.result(timeout=20))[0] for f in futs]
+            wall = time.monotonic() - t0
+            for it, g in zip(trace, got):
+                want = lp_forward(None, it["packed"][None],
+                                  np.array([it["player"]]), None)[0]
+                assert g == pytest.approx(want)
+            h = fleet.health()
+            assert h["hedges"] >= 1, h
+            assert h["hedge_wins"] >= 1, h
+            # hedge wins mean nobody waited out the full 400ms brownout
+            # serially on every slow-placed request
+            assert wall < 8 * 0.4
+        finally:
+            fleet.close()
+            faults.reset()
+
+    def test_hedge_rate_cap_zero_disables(self):
+        cfg = FleetConfig(
+            respawn_base_s=0.001, respawn_cap_s=0.005,
+            hedge_tiers=("interactive",), hedge_min_delay_s=0.001,
+            hedge_max_frac=0.0)
+        fleet = make_fleet("hedge0", fleet_config=cfg)
+        try:
+            faults.add("serving_slow.hedge0-0:slow@50")
+            for it in make_trace(4, rate=200.0, seed=2):
+                fleet.submit(it["packed"], it["player"], it["rank"],
+                             tier="interactive").result(timeout=20)
+            assert fleet.health()["hedges"] == 0
+        finally:
+            fleet.close()
+            faults.reset()
+
+    def test_unhedged_tier_never_hedges(self):
+        cfg = FleetConfig(
+            respawn_base_s=0.001, respawn_cap_s=0.005,
+            hedge_tiers=("interactive",), hedge_min_delay_s=0.001,
+            hedge_max_frac=1.0)
+        fleet = make_fleet("hedgeb", fleet_config=cfg)
+        try:
+            faults.add("serving_slow.hedgeb-0:slow@50")
+            for it in make_trace(4, rate=200.0, seed=3):
+                fleet.submit(it["packed"], it["player"], it["rank"],
+                             tier="batch").result(timeout=20)
+            assert fleet.health()["hedges"] == 0
+        finally:
+            fleet.close()
+            faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# latency-outlier ejection + canary integrity probes
+
+
+class TestEjectionAndCanary:
+    def test_straggler_ejected_and_recycled(self):
+        cfg = FleetConfig(
+            respawn_base_s=0.001, respawn_cap_s=0.005,
+            eject_stragglers=True, eject_min_samples=4,
+            eject_consecutive=1, eject_factor=3.0)
+        fleet = make_fleet("eject", fleet_config=cfg)
+        try:
+            faults.add("serving_slow.eject-0:slow@120")
+            trace = make_trace(200, rate=200.0, seed=4)
+
+            def pump_until_ejected():
+                for it in trace:
+                    fleet.submit(it["packed"], it["player"],
+                                 it["rank"]).result(timeout=20)
+                    if fleet.health()["ejections"] >= 1:
+                        return True
+                return fleet.health()["ejections"] >= 1
+
+            assert pump_until_ejected(), fleet.health()
+            faults.reset()  # close the brownout so the respawn is clean
+            assert wait_until(
+                lambda: fleet.health()["replicas_serving"] == 2)
+        finally:
+            fleet.close()
+            faults.reset()
+
+    def test_eject_replica_is_a_respawn_not_an_outage(self):
+        fleet = make_fleet("recyc")
+        try:
+            assert fleet.eject_replica(0, reason="operator")
+            assert not fleet.eject_replica(0, reason="operator"), \
+                "a replica already respawning cannot be ejected twice"
+            assert wait_until(
+                lambda: fleet.health()["replicas_serving"] == 2)
+            assert fleet.health()["ejections"] == 1
+            assert fleet.health()["respawns"] >= 1
+        finally:
+            fleet.close()
+
+    def test_make_sentinels_dedups_and_limits(self):
+        packed = np.zeros((9, 19, 19), np.uint8)
+        items = [{"packed": packed, "player": 1, "rank": 5,
+                  "digest": d} for d in ("a", "a", "b", "c", "d")]
+        expected = {"a": np.float32(1), "b": np.float32(2),
+                    "c": np.float32(3)}  # "d" has no known-good answer
+        sents = make_sentinels(items, expected, limit=2)
+        assert [s["digest"] for s in sents] == ["a", "b"]
+
+    def test_canary_detects_corrupt_replica_and_recycles(self):
+        fleet = make_fleet("canary")
+        try:
+            it = make_trace(1, seed=5)[0]
+            want = fleet.submit(it["packed"], it["player"],
+                                it["rank"]).result(timeout=20)
+            sentinels = [{"packed": it["packed"], "player": it["player"],
+                          "rank": it["rank"], "digest": "s0",
+                          "expected": np.asarray(want)}]
+            faults.add("serving_corrupt.canary-1:corrupt@1000")
+            prober = CanaryProber(fleet, sentinels, timeout_s=5.0)
+            assert prober.probe_once() == 1
+            rep = prober.report()
+            assert rep["failures"] == 1
+            assert [d["replica"] for d in rep["detected"]] == [1]
+            assert fleet.health()["ejections"] == 1
+            faults.reset()  # the respawned replica comes back clean...
+            assert wait_until(
+                lambda: fleet.health()["replicas_serving"] == 2)
+            assert prober.probe_once() == 0  # ...and probes clean
+        finally:
+            fleet.close()
+            faults.reset()
+
+    def test_probe_errors_are_not_integrity_failures(self):
+        fleet = make_fleet("proberr", replicas=1)
+        try:
+            it = make_trace(1, seed=6)[0]
+            want = fleet.submit(it["packed"], it["player"],
+                                it["rank"]).result(timeout=20)
+            sentinels = [{"packed": it["packed"], "player": it["player"],
+                          "rank": it["rank"], "digest": "s0",
+                          "expected": np.asarray(want)}]
+            prober = CanaryProber(fleet, sentinels, timeout_s=0.0)
+            assert prober.probe_once() == 0  # timeout != wrong answer
+            assert prober.failures == 0 and prober.probes == 1
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# the integrity check: silent corruption becomes a failover
+
+
+class TestIntegrity:
+    def test_corrupt_response_fails_over_to_a_right_answer(self):
+        cfg = FleetConfig(
+            respawn_base_s=0.001, respawn_cap_s=0.005,
+            integrity_check=log_prob_integrity)
+        fleet = make_fleet("integ", fleet_config=cfg)
+        try:
+            faults.add("serving_corrupt.integ-0:corrupt@100")
+            saved = 0
+            for it in make_trace(12, rate=200.0, seed=7):
+                got = np.atleast_1d(fleet.submit(
+                    it["packed"], it["player"],
+                    it["rank"]).result(timeout=20))[0]
+                want = lp_forward(None, it["packed"][None],
+                                  np.array([it["player"]]), None)[0]
+                assert got == pytest.approx(want), \
+                    "a corrupted answer reached the caller"
+                saved = fleet.health()["integrity_failures"]
+                if saved >= 2:
+                    break
+            assert saved >= 1, fleet.health()
+        finally:
+            fleet.close()
+            faults.reset()
+
+    def test_log_prob_integrity_predicate(self):
+        assert log_prob_integrity(np.array([-3.2, -0.1, 0.0]))
+        assert not log_prob_integrity(np.array([-3.2, 1.1]))
+        assert not log_prob_integrity(1.0 - np.array([-3.2, -0.1]))
+
+
+# ---------------------------------------------------------------------------
+# the campaign runner: replay + grade
+
+
+class TestCampaign:
+    def test_grade_report_rules(self):
+        base = {"answers": {"lost": 0, "wrong": 0},
+                "slo": {"ok": True}, "expects_corruption": False}
+        assert grade_report(base)["pass"]
+        assert not grade_report(
+            {**base, "answers": {"lost": 1, "wrong": 0}})["pass"]
+        assert not grade_report(
+            {**base, "answers": {"lost": 0, "wrong": 2}})["pass"]
+        assert not grade_report({**base, "slo": {"ok": False}})["pass"]
+        g = grade_report({**base, "expects_corruption": True,
+                          "canary": {"detected": []}})
+        assert not g["pass"] and "canary" in " ".join(g["reasons"])
+        assert grade_report({**base, "expects_corruption": True,
+                             "canary": {"detected": [{"replica": 1}]}
+                             })["pass"]
+
+    def test_brownout_campaign_defended_grades_pass(self):
+        fleet = make_fleet("camp-b",
+                           fleet_config=defended_config(FAST_FLEET))
+        try:
+            trace = make_trace(40, rate=50.0, seed=8)
+            span = trace[-1]["t"]
+            runner = CampaignRunner(
+                fleet, trace, brownout_scenario(span, brownout_ms=100),
+                CampaignConfig(slo_threshold_s=2.0, slo_target=0.5,
+                               canary=False))
+            report = runner.run()
+            assert report["grade"]["pass"], report["grade"]
+            assert report["answers"]["lost"] == 0
+            assert report["answers"]["wrong"] == 0
+            assert report["answers"]["checked"] > 0
+            assert report["slo"]["requests"] >= len(trace)
+            assert report["defenses"]["hedge_tiers"] == ["interactive"]
+            # the scheduler's executed log made it into the report
+            assert any(e["kind"] == "slow" for e in report["executed"])
+        finally:
+            fleet.close()
+            faults.reset()
+
+    def test_corruption_campaign_canary_detected(self, tmp_path):
+        fleet = make_fleet("camp-c",
+                           fleet_config=defended_config(FAST_FLEET))
+        try:
+            trace = make_trace(50, rate=40.0, seed=9)
+            span = trace[-1]["t"]
+            scenario = Scenario(name="corrupt-only", events=(
+                FaultEvent(at_s=0.1 * span, kind="corrupt", replica=1,
+                           duration_s=0.8 * span, arg=1000),))
+            out = str(tmp_path / "report.json")
+            report = CampaignRunner(
+                fleet, trace, scenario,
+                CampaignConfig(slo_threshold_s=2.0, slo_target=0.5,
+                               canary_interval_s=0.05)).run(
+                                   report_path=out)
+            assert report["expects_corruption"]
+            assert report["answers"]["wrong"] == 0, \
+                "corruption reached a caller"
+            assert report["answers"]["lost"] == 0
+            assert report["canary"]["detected"], report["canary"]
+            assert report["counters"]["ejections"] >= 1
+            assert report["grade"]["pass"], report["grade"]
+            # the report file round-trips and re-grades identically —
+            # the `cli chaos report` contract
+            with open(out, encoding="utf-8") as fh:
+                loaded = json.load(fh)
+            assert grade_report(loaded) == loaded["grade"]
+        finally:
+            fleet.close()
+            faults.reset()

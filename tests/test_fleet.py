@@ -549,3 +549,118 @@ class TestHealthAndClose:
         assert len(games) == 4
         assert stats["engine"]["fleet"]["replicas_total"] == 2
         assert stats["engine"]["boards"] > 0
+
+
+# ---------------------------------------------------------------------------
+# chaos-campaign satellites: lifecycle races the gray-failure work hardened
+
+
+class TestShutdownRespawnRace:
+    def test_close_during_inflight_respawn_neither_hangs_nor_leaks(self):
+        """close(drain=True) racing an in-flight _respawn: close must
+        return (the spawner thread is joined, not abandoned) and the
+        replacement engine the respawn built mid-shutdown must be
+        CLOSED, not leaked with a live dispatcher thread."""
+        gate = threading.Event()
+        entered = threading.Event()
+        engines = []
+
+        def make_replica(i):
+            if len(engines) >= 2:  # a rebuild, not the initial pair:
+                entered.set()      # the rebuild is provably in flight
+                gate.wait(10.0)    # hold it here while close() runs
+            eng = SupervisedEngine(
+                lambda: InferenceEngine(ok_forward, None, ECFG,
+                                        name=f"rep{i}"),
+                config=DIE_FAST, name=f"rep{i}")
+            engines.append(eng)
+            return eng
+
+        fleet = FleetRouter(make_replica, 2, config=FAST_FLEET,
+                            name="close-race", rng=random.Random(0))
+        try:
+            faults.add("serving_dispatch.rep0:fail@1")
+            packed, players, ranks = boards(16, seed=7)
+            for i in range(16):  # submit until the kill lands on rep0
+                f = fleet.submit(packed[i], int(players[i]),
+                                 int(ranks[i]))
+                assert np.atleast_1d(f.result(timeout=20))[0] == \
+                    ok_forward(None, packed, players, ranks)[i]
+                if fleet.health()["failovers"] >= 1:
+                    break
+            assert fleet.health()["failovers"] >= 1
+            # wait for the corpse's rebuild to block INSIDE the factory
+            # (not merely for the "respawning" state, which precedes the
+            # factory call — close() landing in that gap would let
+            # _respawn bail out before ever building engine #3)
+            assert entered.wait(10.0), \
+                "respawn never reached the factory"
+            closer = threading.Thread(target=fleet.close, name="closer")
+            closer.start()
+            closer.join(timeout=0.3)
+            gate.set()  # release the rebuild under a closing fleet
+            closer.join(timeout=20.0)
+            assert not closer.is_alive(), \
+                "close() hung on the in-flight respawn"
+        finally:
+            gate.set()
+            fleet.close()  # idempotent; a no-op when the race path ran
+        # the replacement engine built mid-shutdown was discarded CLOSED
+        assert wait_until(lambda: len(engines) >= 3), \
+            "respawn never reached the factory"
+        # the corpse keeps its terminal "failed" state; every OTHER
+        # engine — the survivor and the mid-shutdown replacement — must
+        # be closed, or a dispatcher thread leaked past close()
+        assert engines[0].health()["state"] in ("failed", "closed")
+        for eng in engines[1:]:
+            assert wait_until(
+                lambda e=eng: e.health()["state"] == "closed"), \
+                f"engine leaked open after close: {eng.health()}"
+        with pytest.raises(EngineClosed):
+            fleet.submit(np.zeros((9, 19, 19), np.uint8), 1, 5)
+
+
+class TestExpiredDeadlineFailover:
+    class _FakeClock:
+        def __init__(self):
+            self.now = 0.0
+
+        def __call__(self):
+            return self.now
+
+    class _HoldReplica(FakeReplica):
+        """Scripted replica whose inner futures the test resolves."""
+
+        def __init__(self, idx, est=None):
+            super().__init__(idx, est=est)
+            self.inners = []
+
+        def submit(self, packed, player, rank, timeout_s=None, block=True):
+            self.submitted += 1
+            f = Future()
+            self.inners.append(f)
+            return f
+
+    def test_expired_deadline_resolves_timeout_not_resurrected(self):
+        """A request whose deadline lapsed while it rode a dying replica
+        gets its TimeoutError verdict from the failover path — it is
+        NOT requeued onto a healthy replica as an already-dead zombie
+        (placement after expiry wastes capacity and can double-serve)."""
+        clk = self._FakeClock()
+        dying = self._HoldReplica(0, est=0.0)
+        healthy = FakeReplica(1, est=1.0)
+        fleet = fake_fleet([dying, healthy], clock=clk)
+        try:
+            f = fleet.submit(np.zeros((9, 19, 19), np.uint8), 1, 5,
+                             timeout_s=0.05)
+            assert dying.submitted == 1 and healthy.submitted == 0
+            clk.now = 1.0  # the deadline lapses in flight...
+            dying.inners[0].set_exception(
+                EngineClosed("replica dying under the request"))
+            with pytest.raises(TimeoutError):  # ...then the replica dies
+                f.result(timeout=10)
+            assert healthy.submitted == 0, \
+                "failover resurrected an expired request"
+            assert fleet.health()["failovers"] == 1
+        finally:
+            fleet.close()
