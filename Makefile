@@ -4,7 +4,7 @@
     verify-resilience verify-fleet verify-distributed verify-obs \
     verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
     verify-cost verify-quant verify-telemetry verify-workload \
-    verify-chaos bench bench-gate smoke clean
+    verify-chaos verify-cache bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -78,7 +78,10 @@ verify-chaos:  # chaos campaigns: fault-kind/scenario/hedging/ejection/canary su
 	JAX_PLATFORMS=cpu python -m deepgo_tpu.cli chaos run --preset full \
 	    --sgf-dir data/sgf/test --requests 120 --rate 40 --seed 0
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload verify-chaos  # the full failure-model suite
+verify-cache:  # position cache: shared digest/augment table pinning, canonical-hit bitwise remap (all 8 views), coalescing + leader-failure promotion, reload invalidation zero-stale, surge-tier routing, cli --simulate-cache
+	JAX_PLATFORMS=cpu python -m pytest tests/test_cache.py -q
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload verify-chaos verify-cache  # the full failure-model suite
 
 bench:
 	python bench.py

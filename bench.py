@@ -649,6 +649,19 @@ def _apply_gate(result: dict, args) -> None:
                        f"{tol!r} (served={var.get('served')}) — the "
                        "quantized program may not serve "
                        f"(was: {result['gate'].get('reason')})")
+    # the position-cache speedup folds in: a trace replay that measured
+    # the cache A/B must clear its target (>2x effective boards/sec,
+    # cache on vs off) — a cache that stopped paying for itself is a
+    # perf regression even when raw throughput passed
+    cache = result.get("cache")
+    if cache is not None:
+        result["gate"]["cache_speedup"] = cache.get("speedup")
+        if not cache.get("ok") and result["gate"].get("verdict") != "fail":
+            result["gate"].update(
+                verdict="fail",
+                reason=f"cache speedup {cache.get('speedup')}x below the "
+                       f"{cache.get('target_speedup')}x target "
+                       f"(was: {result['gate'].get('reason')})")
     # the MFU floor folds in next to the throughput verdict: a run that
     # "won" its boards/sec gate by spending hardware efficiency (bigger
     # pads, silent f32 fallback, a dropped fusion) fails here. Skipped
@@ -1178,6 +1191,83 @@ def _workload_ab(forward, params, ecfg,
         "overhead_frac": round(overhead, 4),
         "rounds": [{k: round(v, 1) for k, v in r.items()} for r in pairs],
         "ok": overhead < 0.02,
+    }
+
+
+def _cache_ab(forward, params, ecfg, trace_items, replicas: int = 2,
+              target_speedup: float = 2.0) -> dict:
+    """The position-cache A/B (serving/cache.py): the SAME captured
+    trace replayed through two fresh 2-replica fleets over the same
+    warm jitted forward — cache off, then cache armed — and the
+    headline is EFFECTIVE boards/sec at the router (ok answers / wall)
+    per arm. Both arms replay in burst mode (arrival timeline
+    collapsed): an open-loop replay at recorded pace finishes in
+    recorded-span seconds regardless of per-request cost, so at 1x the
+    arms would tie on arrival pacing instead of measuring compute — the
+    burst makes the off arm compute-bound, which is the regime a cache
+    exists for. No deadline is set, so nothing sheds and every request
+    resolves; the speedup verdict folds into ``--gate``."""
+    from deepgo_tpu.serving import (CacheConfig, FleetRouter,
+                                    InferenceEngine, SupervisedEngine)
+    from deepgo_tpu.serving import replay as replay_mod
+
+    cache_stats = {}
+
+    def arm(tag: str, cache_cfg) -> float:
+        def make_replica(i: int) -> SupervisedEngine:
+            return SupervisedEngine(
+                lambda: InferenceEngine(forward, params, ecfg,
+                                        name=f"cache-ab-{tag}-{i}"),
+                name=f"cache-ab-{tag}-{i}")
+
+        fleet = FleetRouter(make_replica, replicas,
+                            name=f"cache-ab-{tag}", cache=cache_cfg)
+        fleet.warmup()
+        try:
+            rep = replay_mod.WorkloadReplayer(
+                fleet, trace_items, speed=1e9,
+                collect_timeout_s=120.0).run()
+            if cache_cfg is not None:
+                cache_stats.update(fleet.stats()["fleet"]["cache"])
+        finally:
+            fleet.close()
+        outcomes[tag[:-1]] = rep["outcomes"]
+        ok = rep["outcomes"].get("ok", 0)
+        return ok / rep["wall_s"] if rep["wall_s"] > 0 else 0.0
+
+    outcomes: dict = {}
+    rates = {"off": 0.0, "on": 0.0}
+    for i in range(2):
+        # interleaved best-of-2 per arm, same discipline as _tracing_ab:
+        # scheduler noise hits both arms, the best-of isolates the cache
+        rates["off"] = max(rates["off"], arm(f"off{i}", None))
+        rates["on"] = max(rates["on"], arm(f"on{i}", CacheConfig()))
+    speedup = rates["on"] / rates["off"] if rates["off"] > 0 else None
+    served = cache_stats.get("hits", 0) + cache_stats.get("misses", 0)
+    return {
+        "replicas": replicas,
+        "requests": len(trace_items),
+        "keying": cache_stats.get("keying"),
+        "off_boards_per_sec": round(rates["off"], 1),
+        "on_boards_per_sec": round(rates["on"], 1),
+        "speedup": round(speedup, 3) if speedup is not None else None,
+        "target_speedup": target_speedup,
+        "ok": speedup is not None and speedup >= target_speedup,
+        "hit_rate": (round(cache_stats.get("hits", 0) / served, 4)
+                     if served else None),
+        # hits resolve from the store, coalesced requests ride an
+        # in-flight leader — BOTH avoid a forward, so this is the
+        # number to hold against the capture's projected_hit_rate
+        "forward_frac_avoided": (round(
+            (cache_stats.get("hits", 0) + cache_stats.get("coalesced", 0))
+            / (served + cache_stats.get("coalesced", 0)), 4)
+            if served else None),
+        "hits": cache_stats.get("hits"),
+        "misses": cache_stats.get("misses"),
+        "coalesced": cache_stats.get("coalesced"),
+        "bypassed": cache_stats.get("bypassed"),
+        "evictions": cache_stats.get("evictions"),
+        "outcomes": outcomes,
     }
 
 
@@ -1765,6 +1855,11 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
 
         shutil.rmtree(wl_tmp, ignore_errors=True)
     workload_block["ab"] = _workload_ab(forward, params, ecfg)
+    # the position-cache A/B rides every trace replay: same trace, cache
+    # off vs armed, effective boards/sec at the router (ISSUE 17's >2x
+    # claim, measured); the verdict folds into --gate
+    cache_ab = (_cache_ab(forward, params, ecfg, trace_items)
+                if trace_items is not None else None)
     if replay_report is not None:
         result = {
             "metric": "serving_trace_replay_boards_per_sec",
@@ -1871,6 +1966,8 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
     result["tracing"] = tracing_block
     result["anomalies"] = anomalies_block
     result["workload"] = workload_block
+    if cache_ab is not None:
+        result["cache"] = cache_ab
     if vspec is not None:
         result["variant"] = _variant_ab(variant, vspec, forward, params,
                                         cfg, ecfg, buckets, cost_ledger)
@@ -1899,7 +1996,7 @@ def _bench_serving(on_tpu: bool, faults_spec: str | None = None,
 def _bench_chaos(on_tpu: bool, trace_capture: str | None = None,
                  replay_speed: float = 1.0) -> dict:
     """The chaos campaign gate (deepgo_tpu/chaos, docs/robustness.md):
-    three seeded campaigns over ONE opening-heavy trace, each against a
+    five seeded campaigns over ONE opening-heavy trace, each against a
     fresh 2-replica fleet.
 
       acceptance    kill + brownout + output-corruption mid-trace with
@@ -1912,17 +2009,26 @@ def _bench_chaos(on_tpu: bool, trace_capture: str | None = None,
       brownout OFF  same attack, defenses disarmed — the SLO must FAIL,
                     proving the A/B: the defenses, not the fleet's
                     slack, carry the verdict
+      cache_reload  the position cache armed, a rolling same-value
+                    reload mid-trace then a replica kill — every served
+                    answer must match ground truth (zero wrong, zero
+                    lost) and the stale-hit counter must not move
+      surge         a heterogeneous (tpu, cpu) fleet loses its tpu
+                    replica mid-trace — the cpu surge replica must have
+                    been serving batch traffic already and then absorb
+                    everything without losing an answer
 
     The headline value is the ON arm's within-threshold fraction; the
-    `chaos` block carries all three reports' verdicts; `error` is set
-    (and the exit code nonzero) when any leg of the A/B breaks."""
+    `chaos` block carries every leg's verdict; `error` is set (and the
+    exit code nonzero) when any leg breaks."""
     import jax
 
     from deepgo_tpu.chaos import (CampaignConfig, CampaignRunner,
+                                  FaultEvent, Scenario,
                                   acceptance_scenario, brownout_scenario,
                                   defended_config)
     from deepgo_tpu.models import policy_cnn
-    from deepgo_tpu.serving import (EngineConfig, FleetConfig,
+    from deepgo_tpu.serving import (CacheConfig, EngineConfig, FleetConfig,
                                     SupervisorConfig, fleet_policy_engine)
     from deepgo_tpu.serving import replay as replay_mod
 
@@ -1948,17 +2054,38 @@ def _bench_chaos(on_tpu: bool, trace_capture: str | None = None,
                               speed=replay_speed)
     base = FleetConfig(respawn_base_s=0.01, respawn_cap_s=0.05)
 
-    def run_one(label: str, fleet_cfg, scenario, canary: bool) -> dict:
+    def run_one(label: str, fleet_cfg, scenario, canary: bool,
+                cache=None, platforms=None, reload_np=None) -> dict:
         fleet = fleet_policy_engine(params, cfg, replicas=2, config=ecfg,
                                     fleet=fleet_cfg, supervisor=sup,
-                                    name=label)
+                                    name=label, platforms=platforms,
+                                    cache=cache)
         fleet.warmup()
         try:
-            return CampaignRunner(
+            report = CampaignRunner(
                 fleet, trace, scenario,
-                dataclasses.replace(camp_cfg, canary=canary)).run()
+                dataclasses.replace(camp_cfg, canary=canary),
+                reload_params=reload_np).run()
+            report["replicas_detail"] = [
+                {"replica": s.get("replica"), "platform": s.get("platform"),
+                 "boards": s.get("boards")}
+                for s in fleet.stats()["replicas"]]
+            return report
         finally:
             fleet.close()
+
+    # the cache-integrity leg's attack: a rolling same-value reload
+    # (cache invalidation mid-trace) followed by a replica kill — the
+    # two events that could ever surface a stale or lost cached answer
+    cache_scenario = Scenario(name="cache-reload-kill", seed=17, events=(
+        FaultEvent(at_s=0.35 * span_s, kind="reload"),
+        FaultEvent(at_s=0.55 * span_s, kind="kill", replica=0),))
+    # the surge-tier leg: a heterogeneous (tpu, cpu) fleet loses its
+    # tpu replica mid-trace; the cpu surge replica must already be
+    # carrying batch traffic and then absorb everything
+    surge_scenario = Scenario(name="surge-kill", seed=19, events=(
+        FaultEvent(at_s=0.40 * span_s, kind="kill", replica=0),))
+    same_params = jax.tree.map(lambda x: np.array(x), params)
 
     runs = {
         "acceptance": run_one(
@@ -1969,6 +2096,12 @@ def _bench_chaos(on_tpu: bool, trace_capture: str | None = None,
             brownout_scenario(span_s), canary=False),
         "brownout_off": run_one(
             "chaos-off", base, brownout_scenario(span_s), canary=False),
+        "cache_reload": run_one(
+            "chaos-cache", defended_config(base), cache_scenario,
+            canary=False, cache=CacheConfig(), reload_np=same_params),
+        "surge": run_one(
+            "chaos-surge", defended_config(base), surge_scenario,
+            canary=False, platforms=("tpu", "cpu")),
     }
 
     reasons = []
@@ -1992,6 +2125,32 @@ def _bench_chaos(on_tpu: bool, trace_capture: str | None = None,
                 f"{label}: SLO {'held' if r['slo']['ok'] else 'missed'} "
                 f"(bad_frac {r['slo']['bad_frac']}) — expected "
                 f"{'hold' if want_ok else 'miss'}")
+    cr = runs["cache_reload"]
+    if cr["answers"]["lost"] or cr["answers"]["wrong"]:
+        reasons.append(f"cache_reload: {cr['answers']['wrong']} wrong / "
+                       f"{cr['answers']['lost']} lost answer(s) with the "
+                       "cache armed")
+    cstats = cr.get("cache") or {}
+    if cstats.get("stale_hits", 0):
+        reasons.append(f"cache_reload: {cstats['stale_hits']} stale "
+                       "cache hit(s) across the mid-trace reload")
+    if not cstats.get("hits", 0):
+        reasons.append("cache_reload: the cache never served a hit — "
+                       "the integrity claim tested nothing")
+    if not cr["counters"].get("reloads"):
+        reasons.append("cache_reload: the mid-trace reload never "
+                       "completed")
+    sg = runs["surge"]
+    if sg["answers"]["lost"] or sg["answers"]["wrong"]:
+        reasons.append(f"surge: {sg['answers']['wrong']} wrong / "
+                       f"{sg['answers']['lost']} lost answer(s) on the "
+                       "heterogeneous fleet")
+    if not (sg["counters"]["failovers"] or sg["counters"]["respawns"]):
+        reasons.append("surge: the tpu-replica kill never crossed into "
+                       "the fleet failure domain")
+    if not sum(r["boards"] or 0 for r in sg["replicas_detail"]
+               if r.get("platform") == "cpu"):
+        reasons.append("surge: the cpu surge replica served nothing")
     metric, unit = _METRIC_OF["chaos"]
     result = {
         "bench": "chaos", "metric": metric, "unit": unit,
@@ -2001,6 +2160,8 @@ def _bench_chaos(on_tpu: bool, trace_capture: str | None = None,
         "chaos": {label: {"slo": r["slo"], "answers": r["answers"],
                           "counters": r["counters"],
                           "canary": r["canary"],
+                          "cache": r.get("cache"),
+                          "replicas": r.get("replicas_detail"),
                           "grade": r["grade"]}
                   for label, r in runs.items()},
         "chaos_gate": {"pass": not reasons, "reasons": reasons},
@@ -2054,7 +2215,9 @@ def main() -> None:
                          "instead of the uniform-random submitter "
                          "workload: real positions at recorded "
                          "inter-arrival pace, open loop; the JSON gains "
-                         "a `replay` fidelity block and the headline "
+                         "a `replay` fidelity block, a `cache` block "
+                         "(the position-cache on/off A/B over the same "
+                         "trace, folded into --gate), and the headline "
                          "metric becomes trace-replay goodput")
     ap.add_argument("--replay-speed", type=float, default=1.0,
                     metavar="X",

@@ -121,10 +121,11 @@ class LintConfig:
     # events; the `anomaly` event itself is prefix-free by name and
     # documented next to them) in ISSUE 14; workload_* (the workload
     # observatory capture streams: request/position/capture-summary
-    # records) in ISSUE 15.
+    # records) in ISSUE 15; cache_* (the position cache's invalidation
+    # event) in ISSUE 17.
     grammar_prefixes: tuple = ("deepgo_", "obs_", "loop_", "fleet_",
                                "trace_", "lineage_", "cost_", "ts_",
-                               "anomaly_", "workload_")
+                               "anomaly_", "workload_", "cache_")
     # doc tokens that share a grammar prefix but are not metrics/events:
     # bench JSON keys and similar
     grammar_ignore: frozenset = frozenset({
@@ -132,6 +133,9 @@ class LintConfig:
         # flight-dump section / JSON keys that share the trace_ prefix
         # but are not JSONL event kinds
         "trace_exemplars",
+        # position-cache marks on the trace_request timeline — event
+        # names INSIDE an exemplar's `events` list, not JSONL kinds
+        "cache_hit", "cache_miss", "cache_coalesced", "cache_promoted",
     })
     # files whose emissions feed the grammar check
     grammar_code_roots: tuple = ("deepgo_tpu", "bench.py")

@@ -141,6 +141,13 @@ def grade_report(report: dict) -> dict:
         if not canary.get("detected"):
             reasons.append("corruption injected but never "
                            "canary-detected")
+    cache = report.get("cache")
+    if cache is not None and cache.get("stale_hits", 0) > 0:
+        reasons.append(f"{cache['stale_hits']} stale cache hit(s) — an "
+                       "answer served from a pre-reload generation")
+    for r in report.get("reloads", ()):
+        if not r.get("ok"):
+            reasons.append(f"mid-trace reload failed: {r.get('error')}")
     return {"pass": not reasons, "reasons": reasons}
 
 
@@ -153,13 +160,19 @@ class CampaignRunner:
     build_synthetic_requests."""
 
     def __init__(self, fleet, trace: list[dict], scenario: Scenario,
-                 config: CampaignConfig | None = None):
+                 config: CampaignConfig | None = None,
+                 reload_params=None):
         if not trace:
             raise ValueError("empty trace: nothing to campaign against")
         self.fleet = fleet
         self.trace = trace
         self.scenario = scenario
         self.config = config or CampaignConfig()
+        # the params tree a scheduled ``reload`` event rolls through the
+        # fleet mid-trace (a cache-armed campaign proves zero stale hits
+        # across the invalidation); None = reload events are no-ops
+        self._reload_params = reload_params
+        self._reload_results: list[dict] = []
 
     # -- ground truth --------------------------------------------------------
 
@@ -216,9 +229,11 @@ class CampaignRunner:
             engine=self.fleet.name, tier=cfg.slo_tier)
         good0, total0 = objective.sample()
         counter_keys = ("failovers", "respawns", "poisoned", "hedges",
-                        "hedge_wins", "ejections", "integrity_failures")
+                        "hedge_wins", "ejections", "integrity_failures",
+                        "reloads")
         h0 = self.fleet.health()
         counters0 = {k: h0.get(k, 0) for k in counter_keys}
+        cache0 = h0.get("cache") or {}
 
         prober = None
         if cfg.canary:
@@ -244,9 +259,22 @@ class CampaignRunner:
                 except Exception:  # noqa: BLE001 — shed IS saturation
                     pass
 
+        def reload_params() -> None:
+            t0 = time.time()
+            try:
+                out = self.fleet.reload(self._reload_params)
+                self._reload_results.append(
+                    {"ok": True, "replicas": out["replicas"],
+                     "seconds": round(time.time() - t0, 4)})
+            except Exception as e:  # noqa: BLE001 — reported in the grade
+                self._reload_results.append({"ok": False,
+                                             "error": repr(e)})
+
         scheduler = ScenarioScheduler(
             self.scenario, fleet_name=self.fleet.name,
-            submit_burst=submit_burst)
+            submit_burst=submit_burst,
+            reload_params=(reload_params if self._reload_params is not None
+                           else None))
         replayer = WorkloadReplayer(
             self.fleet, items, speed=cfg.speed,
             timeout_s=cfg.request_timeout_s,
@@ -262,6 +290,15 @@ class CampaignRunner:
             scheduler.stop()
             if prober is not None:
                 prober.stop()
+        # a fired reload runs past the timeline on its own thread (it
+        # blocks on the rolling drain); the report must not snapshot
+        # counters mid-roll
+        fired_reloads = sum(1 for e in scheduler.executed
+                            if e["kind"] == "reload")
+        deadline = time.time() + cfg.collect_timeout_s
+        while (len(self._reload_results) < fired_reloads
+               and time.time() < deadline):
+            time.sleep(0.01)
 
         good1, total1 = objective.sample()
         d_total = total1 - total0
@@ -272,6 +309,25 @@ class CampaignRunner:
         health = self.fleet.health()
         counters = {k: health.get(k, 0) - counters0[k]
                     for k in counter_keys}
+        cache1 = health.get("cache")
+        cache_block = None
+        if cache1 is not None:
+            # campaign-scoped deltas: the integrity claim is about THIS
+            # run — stale_hits must not move across the mid-trace reload
+            cache_block = {
+                "keying": cache1.get("keying"),
+                "hits": cache1.get("hits", 0) - cache0.get("hits", 0),
+                "misses": (cache1.get("misses", 0)
+                           - cache0.get("misses", 0)),
+                "coalesced": (cache1.get("coalesced", 0)
+                              - cache0.get("coalesced", 0)),
+                "invalidations": (cache1.get("invalidations", 0)
+                                  - cache0.get("invalidations", 0)),
+                "stale_hits": (cache1.get("stale_hits", 0)
+                               - cache0.get("stale_hits", 0)),
+                "entries": cache1.get("entries"),
+                "generation": cache1.get("generation"),
+            }
         report = {
             "scenario": self.scenario.to_dict(),
             "executed": list(scheduler.executed),
@@ -308,6 +364,8 @@ class CampaignRunner:
             },
             "canary": prober.report() if prober is not None else None,
             "counters": counters,
+            "cache": cache_block,
+            "reloads": list(self._reload_results),
             "expects_corruption": any(e.kind == "corrupt"
                                       for e in self.scenario.events),
         }

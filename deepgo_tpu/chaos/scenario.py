@@ -20,6 +20,10 @@ at the scheduled moments:
   saturate  submit ``arg`` junk batch-tier requests in one burst
             through the campaign's ``submit_burst`` hook — queue
             pressure, not replica damage
+  reload    fire the campaign's ``reload_params`` hook — a rolling
+            weight reload mid-trace (the position cache's invalidation
+            path). Spawned on its own thread: a reload blocks on the
+            per-replica drain, and the timeline must keep walking
 
 Events target replicas by index; the scheduler maps an index to the
 engine name (``<fleet>-<idx>`` by convention, overridable) because the
@@ -38,7 +42,7 @@ from ..analysis.lockcheck import make_lock
 from ..obs.spans import span
 from ..utils import faults
 
-EVENT_KINDS = ("kill", "slow", "corrupt", "saturate")
+EVENT_KINDS = ("kill", "slow", "corrupt", "saturate", "reload")
 
 
 @dataclass(frozen=True)
@@ -125,11 +129,12 @@ class ScenarioScheduler:
 
     def __init__(self, scenario: Scenario, fleet_name: str = "fleet",
                  engine_name_of=None, submit_burst=None,
-                 clock=time.monotonic):
+                 reload_params=None, clock=time.monotonic):
         self.scenario = scenario
         self._engine_name_of = (engine_name_of
                                 or (lambda i: f"{fleet_name}-{i}"))
         self._submit_burst = submit_burst
+        self._reload_params = reload_params
         self._clock = clock
         self._stop = threading.Event()
         self._lock = make_lock("chaos.scheduler")
@@ -167,6 +172,8 @@ class ScenarioScheduler:
             elif ev.kind == "saturate":
                 acts.append((ev.at_s, ev, "open",
                              lambda n=ev.arg: self._saturate(n)))
+            elif ev.kind == "reload":
+                acts.append((ev.at_s, ev, "open", self._reload))
         acts.sort(key=lambda a: a[0])
         return acts
 
@@ -184,6 +191,14 @@ class ScenarioScheduler:
     def _saturate(self, n: int) -> None:
         if self._submit_burst is not None:
             self._submit_burst(n)
+
+    def _reload(self) -> None:
+        # a rolling reload blocks on every replica's drain — fired on
+        # its own thread so the fault timeline keeps walking behind it
+        if self._reload_params is not None:
+            threading.Thread(target=self._reload_params,
+                             name=f"chaos-reload-{self.scenario.name}",
+                             daemon=True).start()
 
     # -- lifecycle -----------------------------------------------------------
 

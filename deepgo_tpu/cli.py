@@ -476,6 +476,10 @@ def _workload_engine(args):
     return fleet
 
 
+def _pct(rate) -> str:
+    return "n/a" if rate is None else f"{rate:.1%}"
+
+
 def cmd_workload(args) -> None:
     """The workload observatory (obs/workload.py + serving/replay.py,
     docs/observability.md "Workload observatory"):
@@ -499,10 +503,29 @@ def cmd_workload(args) -> None:
 
     if args.wcmd == "analyze":
         stats = workload_mod.analyze_capture(args.capture)
+        if args.simulate_cache:
+            from .serving import cache as cache_mod
+
+            sizes = [int(s) for s in args.simulate_cache.split(",")
+                     if s.strip()]
+            reqs = workload_mod.load_capture(args.capture)["requests"]
+            exact_keys = [r["digest"] for r in reqs]
+            canon_keys = [r.get("canonical") or r["digest"] for r in reqs]
+            stats["simulated_cache"] = {
+                str(size): {
+                    "exact": cache_mod.simulate(exact_keys, size),
+                    "canonical": cache_mod.simulate(canon_keys, size),
+                } for size in sizes}
         if args.json:
             print(_json.dumps(stats, indent=1, default=str))
         else:
             print(workload_mod.format_workload(stats))
+            for size, sim in (stats.get("simulated_cache") or {}).items():
+                ex, ca = sim["exact"], sim["canonical"]
+                print(f"  simulated cache[{size}]: exact "
+                      f"{_pct(ex['hit_rate'])} hit rate "
+                      f"({ex['evictions']} evictions), canonical "
+                      f"{_pct(ca['hit_rate'])} ({ca['evictions']})")
         return
 
     from .serving import replay as replay_mod
@@ -1187,6 +1210,12 @@ def main(argv=None) -> None:
                                         "popularity skew, burstiness, "
                                         "projected cache hit rate")
     w.add_argument("capture", help="capture directory (or workload.jsonl)")
+    w.add_argument("--simulate-cache", default=None, metavar="SIZES",
+                   help="replay the capture's key stream through the "
+                        "position cache's LRU offline at each capacity "
+                        "(comma-separated entry counts) and report the "
+                        "ACHIEVED hit rate per size and keying — the "
+                        "capacity-planning number next to the projection")
     w.add_argument("--json", action="store_true")
     w.set_defaults(fn=cmd_workload)
 

@@ -131,6 +131,14 @@ DEFAULT_WATCHLIST: tuple[WatchSpec, ...] = (
     # the fleet, and a collapse in interactive arrivals is an incident
     # even when the fleet itself is healthy
     WatchSpec("deepgo_workload_requests_total", mode="counter_rate"),
+    # the position cache (ISSUE 17): hit rate collapsing shows up as the
+    # hits counter-rate stepping down while misses step up; ANY stale
+    # hit is an incident — the counter is structurally zero (reload
+    # bumps the cache generation and old-generation fills are refused),
+    # so a single increment means that invariant broke
+    WatchSpec("deepgo_cache_hits_total", mode="counter_rate"),
+    WatchSpec("deepgo_cache_misses_total", mode="counter_rate"),
+    WatchSpec("deepgo_cache_stale_hits_total", mode="increase"),
     WatchSpec("deepgo_loop_games_ingested_total", mode="counter_rate"),
     WatchSpec("deepgo_loop_stalls_total", mode="increase"),
     WatchSpec("deepgo_loop_component_restarts_total", mode="increase"),

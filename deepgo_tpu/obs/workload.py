@@ -47,7 +47,6 @@ observatory".
 from __future__ import annotations
 
 import base64
-import hashlib
 import os
 import queue
 import threading
@@ -56,14 +55,17 @@ import time
 import numpy as np
 
 from ..analysis.lockcheck import make_lock
+from ..utils import digest as _digest
 from .registry import get_registry
 
-# packed-record geometry (features.py) — kept as plain ints so this
-# module stays importable without jax and the digest math is explicit
-PACKED_SHAPE = (9, 19, 19)
-_NUM_POINTS = 19 * 19
+# digest math lives in utils/digest.py — ONE implementation shared with
+# the position cache (serving/cache.py) and training augmentation
+# (ops/augment.py); the names below stay re-exported because captures,
+# tools, and tests address them through this module
+PACKED_SHAPE = _digest.PACKED_SHAPE
+_NUM_POINTS = _digest.NUM_POINTS
 
-_DIGEST_HEX = 16  # 64-bit keys: ample for any real capture corpus
+_DIGEST_HEX = _digest.DIGEST_HEX
 
 # request outcomes a capture distinguishes (the replay side reproduces
 # the submit mix; outcomes re-resolve live)
@@ -78,73 +80,14 @@ class WorkloadCaptureError(RuntimeError):
     """A capture directory is missing, unreadable, or not a capture."""
 
 
-def _dihedral_perms() -> np.ndarray:
-    """(8, 361) int32 gather table: ``view_flat[:, p] = flat[:, PERM[k, p]]``.
+_dihedral_perms = _digest.dihedral_perms
+_PERMS = _digest.PERMS
+NUM_SYMMETRIES = _digest.NUM_SYMMETRIES
 
-    The same construction as ops/augment.py's ``_dihedral_tables`` —
-    recomputed here with numpy alone so the observability layer never
-    imports jax; ``tests/test_workload.py`` pins the two tables equal.
-    """
-    base = np.arange(_NUM_POINTS).reshape(19, 19)
-    perms = []
-    for flip in (False, True):
-        for rot in range(4):
-            grid = np.rot90(base, rot)
-            if flip:
-                grid = np.fliplr(grid)
-            perms.append(grid.reshape(-1))
-    out = np.stack(perms).astype(np.int32)
-    out.setflags(write=False)
-    return out
-
-_PERMS = _dihedral_perms()
-NUM_SYMMETRIES = 8
-
-
-def _digest_bytes(payload: bytes, player: int, rank: int) -> str:
-    # sha256 (truncated to 64 bits) over blake2b: measurably faster on
-    # this container's OpenSSL for the 3.2KB packed record, and the
-    # recorder hashes every request on its writer thread
-    h = hashlib.sha256(payload)
-    h.update(bytes((int(player) & 0xFF, int(rank) & 0xFF)))
-    return h.hexdigest()[:_DIGEST_HEX]
-
-
-def exact_digest(packed: np.ndarray, player: int, rank: int) -> str:
-    """Content digest of one forward input: the packed planes plus the
-    (player, rank) scalars the forward also consumes — two requests
-    share a digest iff their dispatch rows are identical."""
-    arr = np.ascontiguousarray(np.asarray(packed, dtype=np.uint8))
-    if arr.shape != PACKED_SHAPE:
-        raise ValueError(
-            f"packed record shape {arr.shape} != {PACKED_SHAPE}")
-    return _digest_bytes(arr.tobytes(), player, rank)
-
-
-def canonical_digest(packed: np.ndarray, player: int, rank: int) -> str:
-    """The 8-fold-symmetry canonical key: the lexicographic MINIMUM of
-    the exact digests of all eight dihedral views. Go is equivariant
-    under the board symmetries and every packed channel is a spatial
-    map, so all eight views cost one forward in a symmetry-aware cache;
-    the min over a group orbit is view-invariant — every view of a
-    position lands on the same key (the canonicalization tests pin
-    this orbit property and that distinct positions never collide)."""
-    arr = np.ascontiguousarray(np.asarray(packed, dtype=np.uint8))
-    if arr.shape != PACKED_SHAPE:
-        raise ValueError(
-            f"packed record shape {arr.shape} != {PACKED_SHAPE}")
-    flat = arr.reshape(PACKED_SHAPE[0], _NUM_POINTS)
-    return min(_digest_bytes(np.ascontiguousarray(flat[:, _PERMS[k]])
-                             .tobytes(), player, rank)
-               for k in range(NUM_SYMMETRIES))
-
-
-def dihedral_views(packed: np.ndarray) -> list[np.ndarray]:
-    """All eight dihedral views of one packed record (tests + tools)."""
-    arr = np.ascontiguousarray(np.asarray(packed, dtype=np.uint8))
-    flat = arr.reshape(PACKED_SHAPE[0], _NUM_POINTS)
-    return [np.ascontiguousarray(flat[:, _PERMS[k]]).reshape(PACKED_SHAPE)
-            for k in range(NUM_SYMMETRIES)]
+_digest_bytes = _digest.digest_bytes
+exact_digest = _digest.exact_digest
+canonical_digest = _digest.canonical_digest
+dihedral_views = _digest.dihedral_views
 
 
 def encode_packed(packed: np.ndarray) -> str:

@@ -18,42 +18,28 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from .. import BOARD_SIZE, NUM_POINTS
+from .. import NUM_POINTS
+from ..utils import digest as _digest
 
 
 def _dihedral_tables() -> tuple[np.ndarray, np.ndarray]:
     """(PERM, TARGET_MAP), each (8, 361) int32.
 
     Variant k = (r, f) with r quarter-turn rotations (0..3) and f horizontal
-    flip (0..1), applied to the (x, y) grid as numpy rot90/fliplr.
+    flip (0..1), applied to the (x, y) grid as numpy rot90/fliplr. One
+    implementation in ``utils/digest.py``, shared with the workload
+    recorder's content digests and the position cache's canonical-key
+    remap (``tests/test_cache.py`` pins all three consumers equal).
     """
-    base = np.arange(NUM_POINTS).reshape(BOARD_SIZE, BOARD_SIZE)
-    perms, target_maps = [], []
-    for flip in (False, True):
-        for rot in range(4):
-            grid = np.rot90(base, rot)
-            if flip:
-                grid = np.fliplr(grid)
-            # grid[p_new] = p_old  ==> gather table for plane values
-            perms.append(grid.reshape(-1))
-            # a stone/move at old position p lands at the new index of p
-            inv = np.empty(NUM_POINTS, dtype=np.int64)
-            inv[grid.reshape(-1)] = np.arange(NUM_POINTS)
-            target_maps.append(inv)
-    return (
-        np.stack(perms).astype(np.int32),
-        np.stack(target_maps).astype(np.int32),
-    )
+    return _digest.PERMS, _digest.INV_PERMS
 
 
-_PERM_NP, _TARGET_MAP_NP = _dihedral_tables()
 # the tables are baked into every compiled program that traces through
-# augment_batch (jit-boundary): freeze them so an accidental in-place
-# mutation raises immediately instead of silently serving programs
-# compiled against the old values
-_PERM_NP.setflags(write=False)
-_TARGET_MAP_NP.setflags(write=False)
-NUM_SYMMETRIES = 8
+# augment_batch (jit-boundary): utils/digest freezes them at construction
+# so an accidental in-place mutation raises immediately instead of
+# silently serving programs compiled against the old values
+_PERM_NP, _TARGET_MAP_NP = _dihedral_tables()
+NUM_SYMMETRIES = _digest.NUM_SYMMETRIES
 
 
 def augment_batch(packed, target, sym):

@@ -38,6 +38,9 @@ from .engine import (BatchDispatchError, EngineBusy,  # noqa: F401
 from .resilience import (CircuitBreaker, CircuitOpen,  # noqa: F401
                          EngineOverloaded, PoisonedRequest,
                          RestartsExhausted, full_jitter_delay)
+from .cache import (CacheConfig, CacheKeyingError,  # noqa: F401
+                    PositionCache)
+from .cache import simulate as simulate_cache  # noqa: F401
 from .replay import (WorkloadReplayer, build_synthetic_requests,  # noqa: F401
                      load_trace, write_synthetic_capture)
 from .supervisor import SupervisedEngine, SupervisorConfig  # noqa: F401
@@ -183,7 +186,8 @@ def fleet_policy_engine(params, cfg, replicas: int = 2,
                         expand_backend: str = "xla", metrics=None,
                         name: str = "policy-fleet",
                         variants=None, verify: bool = True,
-                        tolerance=None, sample=None) -> FleetRouter:
+                        tolerance=None, sample=None,
+                        platforms=None, cache=None) -> FleetRouter:
     """A FleetRouter of N supervised policy replicas sharing ONE jitted
     forward per variant — so warmup compiles each ladder rung once for
     the whole fleet, and restarts, respawns, and ``reload`` weight swaps
@@ -196,13 +200,36 @@ def fleet_policy_engine(params, cfg, replicas: int = 2,
     behind one router, hot-swappable via ``reload`` (each replica's
     ``prepare_params`` hook re-prepares the new BASE checkpoint for its
     own program). Lossy variants are tolerance-gated ONCE here, before
-    any replica exists — a failing variant refuses to serve."""
+    any replica exists — a failing variant refuses to serve.
+
+    ``platforms`` (a tuple of jax platform names, round-robin like
+    variants) builds a HETEROGENEOUS fleet — ``("tpu", "cpu")`` serves
+    an accelerator replica and a CPU surge replica behind one router,
+    with batch-tier traffic preferring the surge platform
+    (``FleetConfig.surge_platforms``) and cross-platform failover for
+    free. A requested platform with no live devices falls back to the
+    default device (``platform_realized: false`` in health) so chaos
+    benches stay honest on single-platform containers. Mutually
+    exclusive with lossy ``variants``: each feature owns the replica's
+    ``prepare_params`` hook.
+
+    ``cache`` (a CacheConfig or PositionCache) arms the router's
+    content-addressed position cache (serving/cache.py)."""
     from . import variants as variants_mod
 
     if variants is None:
         variants = ("f32",)
     elif isinstance(variants, str):
         variants = (variants,)
+    if platforms is not None and set(variants) != {"f32"}:
+        raise ValueError(
+            "platforms= cannot combine with non-f32 variants: platform "
+            "placement and variant preparation both own the replica's "
+            "prepare_params hook")
+    if platforms is not None:
+        return _platform_fleet(params, cfg, replicas, config, fleet,
+                               supervisor, expand_backend, metrics, name,
+                               tuple(platforms), cache)
     if set(variants) == {"f32"}:
         # the historical pure-f32 fleet: ONE fresh jitted forward per
         # fleet call, shared by its replicas — per-fleet compile
@@ -219,7 +246,8 @@ def fleet_policy_engine(params, cfg, replicas: int = 2,
                 config=supervisor, name=f"{name}-{i}", metrics=metrics)
 
         return FleetRouter(make_f32_replica, replicas, config=fleet,
-                           name=name, metrics=metrics, params=params)
+                           name=name, metrics=metrics, params=params,
+                           cache=cache)
     specs = {}
     for v in dict.fromkeys(variants):  # verify each distinct variant once
         spec, prepared = _resolve_variant(params, cfg, v, expand_backend,
@@ -237,7 +265,58 @@ def fleet_policy_engine(params, cfg, replicas: int = 2,
             config=supervisor, name=f"{name}-{i}", metrics=metrics), spec)
 
     return FleetRouter(make_replica, replicas, config=fleet, name=name,
-                       metrics=metrics, params=params)
+                       metrics=metrics, params=params, cache=cache)
+
+
+def _platform_fleet(params, cfg, replicas, config, fleet, supervisor,
+                    expand_backend, metrics, name, platforms,
+                    cache) -> FleetRouter:
+    """The heterogeneous-platform fleet body: platform assignment is
+    round-robin (mirroring variants), each DISTINCT platform gets its
+    own fresh jitted forward (per-platform compile counters stay
+    scoped), and each replica's params are device_put onto its
+    platform's first device — the multi-platform ``jax_platforms``
+    pattern. The placement hook doubles as ``prepare_params`` so reloads
+    and respawns re-place every new checkpoint on the replica's own
+    device."""
+    import jax
+
+    from ..models.serving import make_log_prob_fn
+
+    if not platforms:
+        raise ValueError("platforms must name at least one jax platform")
+    forwards, devices = {}, {}
+    for p in dict.fromkeys(platforms):
+        forwards[p] = make_log_prob_fn(cfg, expand_backend)
+        try:
+            devices[p] = jax.devices(p)[0]
+        except Exception:  # noqa: BLE001 — platform absent on this host
+            # fall back to the default device so a ("tpu", "cpu") config
+            # stays runnable on a CPU-only container; health reports
+            # platform_realized: false for the unrealized replicas
+            devices[p] = None
+
+    def place(p, tree):
+        dev = devices[p]
+        return tree if dev is None else jax.device_put(tree, dev)
+
+    assignment = [platforms[i % len(platforms)] for i in range(replicas)]
+
+    def make_replica(i: int) -> SupervisedEngine:
+        p = assignment[i]
+        forward = forwards[p]
+        placed = place(p, params)
+        eng = SupervisedEngine(
+            lambda: InferenceEngine(forward, placed, config=config,
+                                    name=f"{name}-{i}", metrics=metrics),
+            config=supervisor, name=f"{name}-{i}", metrics=metrics)
+        eng.platform = p
+        eng.platform_realized = devices[p] is not None
+        eng.prepare_params = lambda base, p=p: place(p, base)
+        return eng
+
+    return FleetRouter(make_replica, replicas, config=fleet, name=name,
+                       metrics=metrics, params=params, cache=cache)
 
 
 def fleet_value_engine(params, cfg, replicas: int = 2,

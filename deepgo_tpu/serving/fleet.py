@@ -62,6 +62,18 @@ router, plus the two things a fleet needs that a single engine does not:
               treated as a replica failure (excluded, failed over,
               counted) instead of being handed to the caller — corrupt
               output becomes lost headroom, never a wrong answer.
+  caching     an optional content-addressed result cache in FRONT of
+              placement (serving/cache.py): repeat positions are served
+              from memory, concurrent same-position submits coalesce
+              onto one forward with leader-failure promotion, and
+              ``reload`` invalidates at both ends of the roll so a
+              stale-weights row is never served.
+  surge tier  heterogeneous replica platforms (``fleet_policy_engine``'s
+              ``platforms=``): batch-tier traffic prefers CPU surge
+              replicas and the latency tiers avoid them, by PREFERENCE —
+              failover crosses platforms when a tier's preferred set
+              dies — and the straggler scan baselines each replica
+              against same-platform peers only.
 
 Fault sites: ``fleet_route`` fires inside each placement attempt (an
 injected fault there is absorbed like a replica failure — excluded,
@@ -95,6 +107,7 @@ from ..analysis.lockcheck import make_lock
 from ..obs import get_registry
 from ..obs.sentinel import flight_dump
 from ..utils import faults
+from .cache import PositionCache, Waiter
 from .engine import EngineBusy, EngineClosed, EngineError
 from .resilience import (CircuitOpen, EngineOverloaded, PoisonedRequest,
                          full_jitter_delay)
@@ -153,7 +166,16 @@ class FleetConfig:
     per-replica latency median exceeds ``eject_factor`` x the median of
     its peers (each over ``eject_min_samples``+ completions) for
     ``eject_consecutive`` consecutive scans is force-recycled.
-    ``integrity_check(row) -> bool`` validates every response row."""
+    ``integrity_check(row) -> bool`` validates every response row.
+
+    ``surge_platforms`` names the CPU surge tier on a heterogeneous
+    fleet: replicas whose engine carries a matching ``platform`` stamp
+    (``fleet_policy_engine(platforms=...)``) are PREFERRED for batch-tier
+    traffic and avoided by the latency tiers — but preference, not
+    partition: when a tier's preferred set is empty (all TPU replicas
+    dead, or a CPU-only fleet) placement falls back to every candidate,
+    so failover crosses platforms automatically. On a homogeneous fleet
+    (no platform stamps) the knob is inert."""
 
     max_failovers: int = 3
     default_tier: str = "interactive"
@@ -176,6 +198,7 @@ class FleetConfig:
     eject_min_samples: int = 20
     eject_consecutive: int = 2
     integrity_check: object = None
+    surge_platforms: tuple = ("cpu",)
 
     def headroom(self, tier: str) -> float:
         return {"interactive": self.interactive_headroom,
@@ -241,7 +264,7 @@ class FleetRouter:
     def __init__(self, make_replica, replicas: int,
                  config: FleetConfig | None = None, name: str = "fleet",
                  metrics=None, clock=time.monotonic, sleep=time.sleep,
-                 rng=None, params=None):
+                 rng=None, params=None, cache=None):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.config = config or FleetConfig()
@@ -249,6 +272,15 @@ class FleetRouter:
             raise ValueError(
                 f"default_tier {self.config.default_tier!r} not in {TIERS}")
         self.name = name
+        # the position cache sits in FRONT of placement (serving/cache.py:
+        # keying, coalescing, invalidation-on-reload); None keeps the
+        # pre-cache door byte-for-byte. A CacheConfig is wrapped here so
+        # callers never have to touch PositionCache directly.
+        if cache is None or isinstance(cache, PositionCache):
+            self.cache = cache
+        else:
+            self.cache = PositionCache(cache, name=f"{name}-cache",
+                                       metrics=metrics)
         self._make_replica = make_replica
         self._metrics = metrics
         self._clock = clock
@@ -414,6 +446,15 @@ class FleetRouter:
                 break
             if kind == "failover" and not payload.future.done():
                 payload.future.set_exception(exc)
+        if self.cache is not None:
+            # failing the queued internal leaders above already walked
+            # complete_err/promotion for most flights; this sweep catches
+            # waiters whose leader future resolved before the callback
+            # could re-dispatch — the no-stranded-waiter contract holds
+            # through the cached door too
+            for key in self.cache.inflight_keys():
+                for w in self.cache.drop_flight(key):
+                    self._resolve_waiter(w, exc)
         if self._metrics is not None:
             self._metrics.write("fleet_close", fleet=self.name,
                                 **self._counters())
@@ -463,6 +504,9 @@ class FleetRouter:
         trace = tracing.start_request(fleet=self.name, tier=tier)
         wl = workload_mod.note_request(packed, player, rank, tier=tier,
                                        fleet=self.name)
+        if self.cache is not None and not self.cache.bypass(tier):
+            return self._submit_cached(packed, player, rank, tier,
+                                       deadline, now, trace, wl, block)
         req = _FleetRequest(np.asarray(packed), int(player), int(rank),
                             tier, deadline, now, trace=trace, workload=wl)
         with self._lock:
@@ -479,6 +523,119 @@ class FleetRouter:
                                 FleetUnavailable)):
                 raise exc  # door-shed surface, same as SupervisedEngine
         return req.future
+
+    # -- the cached door ---------------------------------------------------
+
+    def _submit_cached(self, packed, player, rank, tier, deadline, now,
+                       trace, wl, block) -> Future:
+        """Route one request through the position cache:
+
+        hit       — the stored row (remapped to this view under canonical
+                    keying) resolves the caller immediately; no replica
+                    sees the request.
+        follower  — a leader forward for this key is in flight; the
+                    caller rides it and is resolved by the leader's
+                    completion. Exactly one forward for N submits.
+        leader    — dispatch through the normal placement/failover path;
+                    the internal request's future is DECOUPLED from the
+                    caller's so a leader failure can promote a follower
+                    instead of poisoning everyone (``_on_leader_done``).
+
+        Cache hits deliberately do NOT feed the per-replica/tier latency
+        windows — hedging delays and ejection baselines measure forwards,
+        and letting near-zero hit latencies in would hedge everything."""
+        cache = self.cache
+        caller: Future = Future()
+        with self._lock:
+            self._submits += 1  # the hedge-rate cap's denominator
+        if trace is not None:
+            trace.mark("queued", fleet=self.name, tier=tier)
+            caller.add_done_callback(trace.finish_future)
+        if wl is not None:
+            caller.add_done_callback(wl.finish_future)
+        key, disp_packed, k = cache.prepare(np.asarray(packed),
+                                            int(player), int(rank))
+        waiter = Waiter(caller, k, tier, deadline, trace)
+        role, row = cache.join(key, waiter)
+        if role == "hit":
+            if trace is not None:
+                trace.mark("cache_hit", key=key)
+            self._resolve_waiter(waiter, row)
+            return caller
+        if role == "follower":
+            if trace is not None:
+                trace.mark("cache_coalesced", key=key)
+            return caller
+        if trace is not None:
+            trace.mark("cache_miss", key=key)
+        cache.lead(key, disp_packed, int(player), int(rank), waiter)
+        req = _FleetRequest(np.asarray(disp_packed), int(player),
+                            int(rank), tier, deadline, now, trace=trace,
+                            workload=wl)
+        req.future.add_done_callback(
+            lambda f: self._on_leader_done(key, f))
+        self._dispatch(req, block=block)
+        if caller.done():
+            exc = caller.exception()
+            if isinstance(exc, (EngineOverloaded, CircuitOpen, EngineBusy,
+                                FleetUnavailable)):
+                raise exc  # door-shed surface, same as the uncached path
+        return caller
+
+    @staticmethod
+    def _resolve_waiter(waiter: Waiter, value) -> bool:
+        """Resolve one cache waiter's caller future exactly once; a
+        CacheKeyingError value (an output shape the canonical remap
+        cannot serve across views) resolves as the typed exception."""
+        try:
+            if isinstance(value, BaseException):
+                waiter.future.set_exception(value)
+            else:
+                waiter.future.set_result(value)
+            return True
+        except InvalidStateError:
+            return False
+
+    def _on_leader_done(self, key: str, f: Future) -> None:
+        """The leader's internal forward resolved. Success publishes the
+        fill (same generation only) and resolves every waiter with its
+        per-view remap. Failure is the LEADER'S OWN — its caller gets
+        the error, the next follower is promoted and re-dispatched on
+        the router thread (never this resolver thread), and the chain
+        terminates because each promotion consumes a waiter."""
+        cache = self.cache
+        exc = (EngineClosed(f"FleetRouter[{self.name}] cancelled a "
+                            "cached leader") if f.cancelled()
+               else f.exception())
+        if exc is None:
+            for w, value in cache.complete_ok(key, f.result()):
+                self._resolve_waiter(w, value)
+            return
+        leader, promoted, dispatch = cache.complete_err(key)
+        if leader is not None:
+            self._resolve_waiter(leader, exc)
+        if promoted is None:
+            return
+        if promoted.trace is not None:
+            promoted.trace.mark("cache_promoted", key=key)
+        if self._closing.is_set():
+            closed = EngineClosed(
+                f"FleetRouter[{self.name}] closed with request pending")
+            self._resolve_waiter(promoted, closed)
+            for w in cache.drop_flight(key):
+                self._resolve_waiter(w, closed)
+            return
+        packed, player, rank = dispatch
+        # the promoted waiter's OWN deadline/trace ride the re-dispatch;
+        # its workload token keeps finishing through its caller future
+        # (the bucket stamp of the failed leader's forward is lost —
+        # acceptable: promotions are failure-path rare)
+        req = _FleetRequest(packed, player, rank, promoted.tier,
+                            promoted.deadline, self._clock(),
+                            trace=promoted.trace, workload=None)
+        req.future.add_done_callback(
+            lambda f2: self._on_leader_done(key, f2))
+        self._events.put(("failover", req))
 
     def evaluate(self, packed: np.ndarray, players: np.ndarray,
                  ranks: np.ndarray, timeout_s: float | None = None,
@@ -522,6 +679,7 @@ class FleetRouter:
             rr = self._rr
         if not cands:
             return None
+        cands = self._platform_preference(cands, req.tier)
         n = len(self._replicas)
 
         def key(r):
@@ -533,6 +691,25 @@ class FleetRouter:
                     (r.idx - rr) % n)
 
         return min(cands, key=key)
+
+    def _platform_preference(self, cands: list, tier: str) -> list:
+        """The CPU surge tier's routing rule: batch-tier traffic prefers
+        ``surge_platforms`` replicas (bulk scans tolerate CPU latency and
+        free the accelerators), every other tier avoids them. Preference
+        only — an empty preferred set falls back to all candidates, so a
+        fleet whose TPU replicas all died keeps serving interactive
+        traffic on the surge tier, and a homogeneous fleet (no platform
+        stamps) is untouched."""
+        surge = self.config.surge_platforms
+        if not surge:
+            return cands
+        if tier == "batch":
+            pref = [r for r in cands
+                    if getattr(r.engine, "platform", None) in surge]
+        else:
+            pref = [r for r in cands
+                    if getattr(r.engine, "platform", None) not in surge]
+        return pref or cands
 
     def _dispatch(self, req: _FleetRequest, block: bool = True) -> None:
         """Route one request: try candidates best-first until a replica
@@ -940,20 +1117,29 @@ class FleetRouter:
         can't drag the baseline up to its own level — for
         ``eject_consecutive`` consecutive scans is recycled. Persistence
         gating keeps one GC pause or one unlucky batch from costing a
-        respawn."""
+        respawn. On a heterogeneous fleet the baseline is SAME-PLATFORM
+        peers only — a CPU surge replica is slower than its TPU peers by
+        design, not by gray failure, and a platform singleton (no peer to
+        compare against) is never ejected for latency."""
         cfg = self.config
         with self._lock:
             meds = {rep.idx: float(np.median(np.array(rep.lat)))
                     for rep in self._replicas
                     if rep.state == "serving"
                     and len(rep.lat) >= cfg.eject_min_samples}
+            plats = {rep.idx: getattr(rep.engine, "platform", None)
+                     for rep in self._replicas}
         if len(meds) < 2:
             return
         for rep in self._replicas:
             mine = meds.get(rep.idx)
             if mine is None:
                 continue
-            peers = [v for k, v in meds.items() if k != rep.idx]
+            peers = [v for k, v in meds.items()
+                     if k != rep.idx and plats.get(k) == plats.get(rep.idx)]
+            if not peers:
+                rep.eject_strikes = 0
+                continue
             base = float(np.median(np.array(peers)))
             if base > 0.0 and mine > cfg.eject_factor * base:
                 rep.eject_strikes += 1
@@ -1063,39 +1249,53 @@ class FleetRouter:
             # from this instant every respawn/rebuild converges on the
             # new weights, even for replicas the roll hasn't reached yet
             self._current_params = params
+            # stale-weights answers are wrong answers: clear BEFORE the
+            # roll (old-weights entries must not outlive the moment the
+            # new checkpoint became the source of truth) and AFTER it
+            # (forwards that ran mid-roll on a not-yet-swapped replica
+            # filled under the new generation — legitimate old-or-new
+            # answers while rolling, stale the instant the roll is done).
+            # Generation capture in the cache refuses fills from flights
+            # led before each clear, so no mixed-weights row survives.
+            if self.cache is not None:
+                self.cache.invalidate("reload_start")
             budget = (self.config.drain_timeout_s
                       if drain_timeout_s is None else drain_timeout_s)
             swapped = 0
-            for rep in self._replicas:
-                if self._closing.is_set():
-                    raise EngineClosed(
-                        f"FleetRouter[{self.name}] closed mid-reload "
-                        f"({swapped} replica(s) already swapped)")
-                with self._lock:
-                    if rep.state != "serving":
-                        continue  # respawn path applies the new weights
-                    rep.state = "draining"
-                self._update_serving_gauge()
-                try:
-                    deadline = self._clock() + budget
-                    while (rep.pending > 0 and self._clock() < deadline
-                           and not self._closing.is_set()):
-                        self._sleep(0.002)
-                    try:
-                        faults.check("fleet_reload")
-                    except faults.FaultError as e:
-                        raise FleetReloadError(
-                            f"FleetRouter[{self.name}] reload failed at "
-                            f"replica {rep.idx} ({swapped} already "
-                            "swapped; restarts/respawns will converge on "
-                            "the new weights)") from e
-                    self._apply_params(rep.engine, params)
-                    swapped += 1
-                finally:
+            try:
+                for rep in self._replicas:
+                    if self._closing.is_set():
+                        raise EngineClosed(
+                            f"FleetRouter[{self.name}] closed mid-reload "
+                            f"({swapped} replica(s) already swapped)")
                     with self._lock:
-                        if rep.state == "draining":
-                            rep.state = "serving"
+                        if rep.state != "serving":
+                            continue  # respawn path applies the new weights
+                        rep.state = "draining"
                     self._update_serving_gauge()
+                    try:
+                        deadline = self._clock() + budget
+                        while (rep.pending > 0 and self._clock() < deadline
+                               and not self._closing.is_set()):
+                            self._sleep(0.002)
+                        try:
+                            faults.check("fleet_reload")
+                        except faults.FaultError as e:
+                            raise FleetReloadError(
+                                f"FleetRouter[{self.name}] reload failed at "
+                                f"replica {rep.idx} ({swapped} already "
+                                "swapped; restarts/respawns will converge on "
+                                "the new weights)") from e
+                        self._apply_params(rep.engine, params)
+                        swapped += 1
+                    finally:
+                        with self._lock:
+                            if rep.state == "draining":
+                                rep.state = "serving"
+                        self._update_serving_gauge()
+            finally:
+                if self.cache is not None:
+                    self.cache.invalidate("reload_end")
             dt = self._clock() - t0
             with self._lock:
                 self._reloads += 1
@@ -1209,6 +1409,12 @@ class FleetRouter:
             variant = getattr(r.engine, "variant", None)
             if variant is not None:
                 entry["variant"] = variant
+            platform = getattr(r.engine, "platform", None)
+            if platform is not None:
+                entry["platform"] = platform
+                realized = getattr(r.engine, "platform_realized", None)
+                if realized is not None:
+                    entry["platform_realized"] = realized
             if r.state in ("serving", "draining"):
                 try:
                     h = r.engine.health()
@@ -1236,6 +1442,8 @@ class FleetRouter:
                "replicas_total": len(reps),
                "estimated_wait_s": self.estimated_wait_s(),
                "tiers": self._tier_latency(), "replicas": detail}
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
         out.update(self._counters())
         return out
 
@@ -1257,11 +1465,16 @@ class FleetRouter:
             variant = getattr(r.engine, "variant", None)
             if variant is not None:
                 s["variant"] = variant
+            platform = getattr(r.engine, "platform", None)
+            if platform is not None:
+                s["platform"] = platform
             boards += s.get("boards") or 0
             replica_stats.append(s)
         with self._lock:
             failover_lat = list(self._failover_lat)
         fleet = self._counters()
+        if self.cache is not None:
+            fleet["cache"] = self.cache.stats()
         fleet.update({
             "replicas_serving": self._serving_count(),
             "replicas_total": len(reps),

@@ -1,0 +1,709 @@
+"""Content-addressed position cache + CPU surge tier (serving/cache.py).
+
+The load-bearing contracts:
+
+  * one digest implementation: ``utils/digest.py`` tables pinned equal
+    to ``ops/augment``'s and to the workload recorder's;
+  * exact-key hits are the SAME bytes as an uncached forward; canonical
+    hits are bitwise-identical for all 8 dihedral views of a position
+    (property-tested with an equivariant-by-construction forward);
+  * coalescing: N in-flight submits of one digest cost exactly one
+    forward, and a failed leader never poisons followers — the next
+    follower is promoted and re-dispatched;
+  * ``fleet.reload()`` invalidates: mid-reload submits resolve to
+    exactly old-or-new-checkpoint outputs with ZERO stale cache hits
+    (the PR 13 old-or-new proof extended to the cached path);
+  * batch-tier bypass keeps bulk scans out of the LRU;
+  * the offline simulator reports the ACHIEVED hit rate per capacity
+    (``cli workload analyze --simulate-cache``);
+  * the CPU surge tier: heterogeneous-platform fleets route batch-tier
+    traffic to CPU replicas, fail over across platforms when replicas
+    die, and scope the straggler-ejection baseline per platform.
+"""
+
+import json
+import os
+import random
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepgo_tpu.models import ModelConfig, init
+from deepgo_tpu.obs import workload as wl
+from deepgo_tpu.ops import augment
+from deepgo_tpu.serving import (CacheConfig, EngineConfig, FailoverExhausted,
+                                FleetConfig, FleetRouter, InferenceEngine,
+                                PositionCache, SupervisedEngine,
+                                SupervisorConfig, fleet_policy_engine,
+                                simulate_cache)
+from deepgo_tpu.serving.cache import CacheKeyingError, Waiter
+from deepgo_tpu.utils import digest as dg
+from deepgo_tpu.utils import faults
+
+SGF_DIR = os.path.join(os.path.dirname(__file__), "..", "data", "sgf",
+                       "test")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def boards(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 3, size=(n, 9, 19, 19), dtype=np.uint8),
+            rng.integers(1, 3, size=n).astype(np.int32),
+            rng.integers(1, 10, size=n).astype(np.int32))
+
+
+def ok_forward(params, packed, player, rank):
+    return np.asarray(packed, np.float32).sum(axis=(1, 2, 3)) \
+        + 1000.0 * np.asarray(player, np.float32)
+
+
+def point_forward(params, packed, player, rank):
+    """Per-point local forward: out[b, p] depends only on the channel
+    column at p, so it is equivariant under any spatial permutation —
+    the property the canonical-key remap requires — and bitwise stable
+    (same channel order, same summation order, at every point)."""
+    b = len(packed)
+    flat = np.asarray(packed, np.float32).reshape(b, 9, 361)
+    return flat.sum(axis=1) * 0.125 \
+        + np.asarray(player, np.float32)[:, None]
+
+
+def weight_forward(params, packed, player, rank):
+    w = np.float32(0.0) if params is None else np.float32(params["w"])
+    return ok_forward(params, packed, player, rank) + 1000.0 * w
+
+
+ECFG = EngineConfig(buckets=(1, 4), max_wait_ms=0.0)
+FAST_FLEET = FleetConfig(respawn_base_s=0.001, respawn_cap_s=0.005)
+
+
+def make_fleet(forward=ok_forward, replicas=2, fleet_config=FAST_FLEET,
+               sup_config=None, engine_config=ECFG, params=None, **kw):
+    def make_replica(i):
+        return SupervisedEngine(
+            lambda: InferenceEngine(forward, params, engine_config,
+                                    name=f"rep{i}"),
+            config=sup_config, name=f"rep{i}")
+
+    kw.setdefault("rng", random.Random(0))
+    return FleetRouter(make_replica, replicas, config=fleet_config,
+                       name=kw.pop("name", "cache-fleet"), **kw)
+
+
+def wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+class ScriptedReplica:
+    """Duck-typed replica whose futures the TEST resolves — makes the
+    leader-failure/promotion protocol fully deterministic."""
+
+    def __init__(self, idx, platform=None, est=None):
+        self.idx = idx
+        self.est = est
+        self.futs = []
+        self.fail_next = 0
+        self.auto_value = None
+        if platform is not None:
+            self.platform = platform
+
+    def submit(self, packed, player, rank, timeout_s=None, block=True):
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            from deepgo_tpu.serving import EngineClosed
+
+            raise EngineClosed("scripted submit failure")
+        f = Future()
+        if self.auto_value is not None:
+            f.set_result(self.auto_value)
+        self.futs.append(f)
+        return f
+
+    def estimated_wait_s(self):
+        return self.est
+
+    def health(self):
+        return {"state": "serving", "estimated_wait_s": self.est,
+                "breaker": {"state": "closed"}}
+
+    def stats(self):
+        return {"boards": len(self.futs)}
+
+    def warmup(self):
+        return 0
+
+    def compile_cache_size(self):
+        return None
+
+    def set_params(self, params):
+        pass
+
+    @property
+    def params(self):
+        return None
+
+    def close(self, drain=True, timeout=1.0):
+        pass
+
+
+def scripted_fleet(reps, fleet_config=None, **kw):
+    kw.setdefault("rng", random.Random(0))
+    return FleetRouter(lambda i: reps[i], len(reps), config=fleet_config,
+                       name=kw.pop("name", "scripted"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# one digest implementation
+
+
+class TestDigestModule:
+    def test_tables_pinned_to_augment(self):
+        assert np.array_equal(dg.PERMS, augment._PERM_NP)
+        assert np.array_equal(dg.INV_PERMS, augment._TARGET_MAP_NP)
+
+    def test_tables_frozen(self):
+        for table in (dg.PERMS, dg.INV_PERMS):
+            with pytest.raises(ValueError):
+                table[0, 0] = 0
+
+    def test_workload_recorder_shares_the_implementation(self):
+        assert wl.exact_digest is dg.exact_digest
+        assert wl.canonical_digest is dg.canonical_digest
+        assert wl._PERMS is dg.PERMS
+
+    def test_inverse_really_inverts(self):
+        for k in range(8):
+            assert np.array_equal(dg.INV_PERMS[k][dg.PERMS[k]],
+                                  np.arange(361))
+
+    def test_canonicalize_orbit_invariant(self):
+        packed, players, ranks = boards(1, seed=3)
+        base, player, rank = packed[0], int(players[0]), int(ranks[0])
+        key0, view0, _ = dg.canonicalize(base, player, rank)
+        assert key0 == dg.canonical_digest(base, player, rank)
+        for v in dg.dihedral_views(base):
+            key, view, k = dg.canonicalize(v, player, rank)
+            assert key == key0
+            assert np.array_equal(view, view0)
+            # the returned k maps the canonical view back to THIS view
+            flat = np.ascontiguousarray(v).reshape(9, 361)
+            assert np.array_equal(
+                view.reshape(9, 361), flat[:, dg.PERMS[k]])
+
+    def test_remap_is_bitwise_for_equivariant_forward(self):
+        packed, players, ranks = boards(1, seed=4)
+        base, player, rank = packed[0], int(players[0]), int(ranks[0])
+        for v in dg.dihedral_views(base):
+            _, canon, k = dg.canonicalize(v, player, rank)
+            via_cache = dg.remap_from_canonical(
+                point_forward(None, canon[None], [player], [rank])[0], k)
+            direct = point_forward(None, v[None], [player], [rank])[0]
+            assert np.array_equal(via_cache, direct)
+
+    def test_remap_rejects_unmappable_shapes(self):
+        with pytest.raises(ValueError):
+            dg.remap_from_canonical(np.zeros(7, np.float32), 3)
+
+
+# ---------------------------------------------------------------------------
+# the cache core (no fleet)
+
+
+def _put(cache, key, row, k=0, tier="interactive"):
+    w = Waiter(Future(), k, tier, None, None)
+    role, _ = cache.join(key, w)
+    assert role == "leader"
+    cache.lead(key, np.zeros((9, 19, 19), np.uint8), 1, 1, w)
+    for waiter, value in cache.complete_ok(key, row):
+        waiter.future.set_result(value)
+    return w.future.result(timeout=1)
+
+
+class TestCacheCore:
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            CacheConfig(capacity=-1)
+        with pytest.raises(ValueError):
+            CacheConfig(keying="fuzzy")
+
+    def test_hit_returns_stored_bytes(self):
+        cache = PositionCache(CacheConfig(capacity=4))
+        row = np.arange(4, dtype=np.float32)
+        _put(cache, "k1", row)
+        w = Waiter(Future(), 0, "interactive", None, None)
+        role, got = cache.join("k1", w)
+        assert role == "hit"
+        assert np.array_equal(got, row)
+        s = cache.stats()
+        assert s["hits"] == 1 and s["misses"] == 1
+        assert s["entries"] == 1 and s["bytes"] == row.nbytes
+
+    def test_lru_eviction_order_and_counter(self):
+        cache = PositionCache(CacheConfig(capacity=2))
+        _put(cache, "a", np.float32([1]))
+        _put(cache, "b", np.float32([2]))
+        # touch "a" so "b" is the LRU victim
+        role, _ = cache.join("a", Waiter(Future(), 0, None, None, None))
+        assert role == "hit"
+        _put(cache, "c", np.float32([3]))
+        assert cache.stats()["entries"] == 2
+        assert cache.stats()["evictions"] == 1
+        role, _ = cache.join("b", Waiter(Future(), 0, None, None, None))
+        assert role == "leader"  # evicted
+        cache.drop_flight("b")
+        role, _ = cache.join("a", Waiter(Future(), 0, None, None, None))
+        assert role == "hit"     # survived
+
+    def test_followers_resolved_with_per_view_remap(self):
+        cache = PositionCache(CacheConfig(capacity=4, keying="canonical"))
+        leader = Waiter(Future(), 0, "interactive", None, None)
+        role, _ = cache.join("k", leader)
+        cache.lead("k", np.zeros((9, 19, 19), np.uint8), 1, 1, leader)
+        f1 = Waiter(Future(), 1, "interactive", None, None)
+        assert cache.join("k", f1)[0] == "follower"
+        row = np.arange(361, dtype=np.float32)
+        resolved = cache.complete_ok("k", row)
+        assert len(resolved) == 2
+        for w, value in resolved:
+            w.future.set_result(value)
+        assert np.array_equal(leader.future.result(), row)
+        assert np.array_equal(f1.future.result(),
+                              row[dg.INV_PERMS[1]])
+        assert cache.stats()["coalesced"] == 1
+
+    def test_canonical_remap_of_non_row_output_is_typed(self):
+        cache = PositionCache(CacheConfig(keying="canonical"))
+        leader = Waiter(Future(), 2, None, None, None)
+        cache.join("k", leader)
+        cache.lead("k", np.zeros((9, 19, 19), np.uint8), 1, 1, leader)
+        (w, value), = cache.complete_ok("k", np.zeros(5, np.float32))
+        assert isinstance(value, CacheKeyingError)
+
+    def test_scalar_outputs_are_symmetry_invariant(self):
+        cache = PositionCache(CacheConfig(keying="canonical"))
+        _put(cache, "k", np.float32(7.5), k=3)
+        w = Waiter(Future(), 5, None, None, None)
+        role, got = cache.join("k", w)
+        assert role == "hit" and got == np.float32(7.5)
+
+    def test_promotion_consumes_leader_first(self):
+        cache = PositionCache(CacheConfig(capacity=4))
+        ws = [Waiter(Future(), 0, None, None, None) for _ in range(3)]
+        cache.join("k", ws[0])
+        cache.lead("k", np.zeros((9, 19, 19), np.uint8), 1, 1, ws[0])
+        assert cache.join("k", ws[1])[0] == "follower"
+        assert cache.join("k", ws[2])[0] == "follower"
+        leader, promoted, dispatch = cache.complete_err("k")
+        assert leader is ws[0] and promoted is ws[1]
+        assert dispatch is not None
+        # the promoted leader succeeds: remaining waiters all resolve
+        resolved = cache.complete_ok("k", np.float32([9]))
+        assert [w for w, _ in resolved] == [ws[1], ws[2]]
+        leader2, promoted2, _ = cache.complete_err("k")
+        assert leader2 is None and promoted2 is None
+
+    def test_invalidate_clears_and_refuses_old_generation_fills(self):
+        cache = PositionCache(CacheConfig(capacity=4))
+        _put(cache, "old", np.float32([1]))
+        w = Waiter(Future(), 0, None, None, None)
+        cache.join("inflight", w)
+        cache.lead("inflight", np.zeros((9, 19, 19), np.uint8), 1, 1, w)
+        dropped = cache.invalidate("reload_start")
+        assert dropped == 1
+        assert cache.stats()["entries"] == 0
+        # the in-flight leader still serves its waiter ...
+        resolved = cache.complete_ok("inflight", np.float32([2]))
+        assert len(resolved) == 1
+        # ... but its fill was refused: the old generation never lands
+        role, _ = cache.join(
+            "inflight", Waiter(Future(), 0, None, None, None))
+        assert role == "leader"
+        cache.drop_flight("inflight")
+        s = cache.stats()
+        assert s["invalidations"] == 1
+        assert s["stale_hits"] == 0
+
+    def test_simulator_reports_achieved_hit_rate(self):
+        keys = ["a", "b", "a", "c", "a", "b", "d", "a"]
+        big = simulate_cache(keys, capacity=64)
+        assert big["hits"] == 4 and big["misses"] == 4
+        assert big["hit_rate"] == 0.5
+        one = simulate_cache(keys, capacity=1)
+        assert one["hits"] < big["hits"]
+        assert one["requests"] == len(keys)
+        assert simulate_cache([], capacity=4)["hit_rate"] is None
+        with pytest.raises(ValueError):
+            simulate_cache(keys, capacity=-2)
+
+
+# ---------------------------------------------------------------------------
+# the cached fleet door
+
+
+class TestCachedFleet:
+    def test_exact_hits_bitwise_and_one_forward(self):
+        fleet = make_fleet(replicas=2, cache=CacheConfig(capacity=64))
+        try:
+            packed, players, ranks = boards(1, seed=1)
+            args = (packed[0], int(players[0]), int(ranks[0]))
+            first = fleet.submit(*args).result(timeout=10)
+            second = fleet.submit(*args).result(timeout=10)
+            direct = ok_forward(None, packed[:1], players[:1], ranks[:1])[0]
+            assert np.array_equal(first, direct)
+            assert np.array_equal(second, direct)
+            s = fleet.cache.stats()
+            assert s["hits"] == 1 and s["misses"] == 1
+            assert fleet.stats()["boards"] == 1  # one real forward
+        finally:
+            fleet.close()
+
+    def test_canonical_hits_bitwise_for_all_eight_views(self):
+        fleet = make_fleet(point_forward, replicas=2,
+                           cache=CacheConfig(capacity=64,
+                                             keying="canonical"))
+        try:
+            packed, players, ranks = boards(1, seed=2)
+            player, rank = int(players[0]), int(ranks[0])
+            views = dg.dihedral_views(packed[0])
+            # prime with the FIRST view; every view must then hit
+            fleet.submit(views[0], player, rank).result(timeout=10)
+            assert fleet.cache.stats()["misses"] == 1
+            for v in views:
+                got = fleet.submit(v, player, rank).result(timeout=10)
+                direct = point_forward(None, v[None], [player], [rank])[0]
+                assert np.array_equal(got, direct)
+            s = fleet.cache.stats()
+            assert s["hits"] == len(views)
+            assert s["misses"] == 1
+            assert fleet.stats()["boards"] == 1
+        finally:
+            fleet.close()
+
+    def test_coalescing_costs_one_forward(self):
+        release = threading.Event()
+        calls = []
+
+        def gated_forward(params, packed, player, rank):
+            calls.append(len(packed))
+            release.wait(timeout=10)
+            return ok_forward(params, packed, player, rank)
+
+        fleet = make_fleet(gated_forward, replicas=1,
+                           cache=CacheConfig(capacity=64))
+        try:
+            packed, players, ranks = boards(1, seed=5)
+            args = (packed[0], int(players[0]), int(ranks[0]))
+            futs = [fleet.submit(*args) for _ in range(6)]
+            assert wait_until(
+                lambda: fleet.cache.stats()["coalesced"] == 5, timeout=5)
+            release.set()
+            rows = [f.result(timeout=10) for f in futs]
+            direct = ok_forward(None, packed[:1], players[:1], ranks[:1])[0]
+            for row in rows:
+                assert np.array_equal(row, direct)
+            assert sum(calls) == 1
+            s = fleet.cache.stats()
+            assert s["misses"] == 1 and s["coalesced"] == 5
+        finally:
+            release.set()
+            fleet.close()
+
+    def test_failed_leader_promotes_follower(self):
+        rep = ScriptedReplica(0)
+        fleet = scripted_fleet([rep], cache=CacheConfig(capacity=16))
+        try:
+            packed, players, ranks = boards(1, seed=6)
+            args = (packed[0], int(players[0]), int(ranks[0]))
+            leader_fut = fleet.submit(*args)
+            assert len(rep.futs) == 1
+            followers = [fleet.submit(*args) for _ in range(2)]
+            assert fleet.cache.stats()["coalesced"] == 2
+            # the replica dies under the leader's forward: terminal for
+            # the leader (its only candidate is excluded), never for
+            # the followers
+            rep.futs[0].set_exception(RuntimeError("died mid-forward"))
+            with pytest.raises(FailoverExhausted):
+                leader_fut.result(timeout=10)
+            assert wait_until(lambda: len(rep.futs) == 2, timeout=5)
+            rep.futs[1].set_result(np.float32(42.0))
+            for f in followers:
+                assert f.result(timeout=10) == np.float32(42.0)
+            # the promoted forward's fill landed: next submit hits
+            assert fleet.submit(*args).result(timeout=10) \
+                == np.float32(42.0)
+            assert fleet.cache.stats()["hits"] == 1
+        finally:
+            fleet.close()
+
+    def test_reload_invalidates_no_stale_hits(self):
+        fleet = make_fleet(weight_forward, replicas=2,
+                           cache=CacheConfig(capacity=64),
+                           params={"w": np.float32(0.0)})
+        try:
+            packed, players, ranks = boards(1, seed=7)
+            args = (packed[0], int(players[0]), int(ranks[0]))
+            v0 = fleet.submit(*args).result(timeout=10)
+            assert fleet.submit(*args).result(timeout=10) == v0  # cached
+            fleet.reload({"w": np.float32(1.0)})
+            v1 = fleet.submit(*args).result(timeout=10)
+            assert v1 == v0 + np.float32(1000.0)
+            s = fleet.cache.stats()
+            assert s["invalidations"] >= 2  # reload start + end
+            assert s["stale_hits"] == 0 and s["stale_blocked"] == 0
+        finally:
+            fleet.close()
+
+    def test_mid_reload_submits_resolve_old_or_new_zero_stale(self):
+        """The PR 13 old-or-new proof through the CACHED door: while a
+        reload rolls, every cached-path result is exactly the old or
+        the new checkpoint's output; after the roll, only the new."""
+        fleet = make_fleet(weight_forward, replicas=2,
+                           cache=CacheConfig(capacity=64),
+                           params={"w": np.float32(0.0)})
+        try:
+            packed, players, ranks = boards(4, seed=8)
+            reqs = [(packed[i], int(players[i]), int(ranks[i]))
+                    for i in range(4)]
+            olds = {i: fleet.submit(*reqs[i]).result(timeout=10)
+                    for i in range(4)}
+            stop = threading.Event()
+            got, errs = [], []
+
+            def spam():
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    try:
+                        got.append(
+                            (i % 4,
+                             fleet.submit(*reqs[i % 4]).result(timeout=10)))
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+            t = threading.Thread(target=spam)
+            t.start()
+            try:
+                fleet.reload({"w": np.float32(1.0)})
+            finally:
+                stop.set()
+                t.join(timeout=15)
+            assert not errs
+            news = {i: olds[i] + np.float32(1000.0) for i in olds}
+            for i, value in got:
+                assert value in (olds[i], news[i])
+            # post-reload: the new weights only, and zero stale serves
+            for i in range(4):
+                assert fleet.submit(*reqs[i]).result(timeout=10) == news[i]
+            s = fleet.cache.stats()
+            assert s["stale_hits"] == 0 and s["stale_blocked"] == 0
+        finally:
+            fleet.close()
+
+    def test_batch_tier_bypasses_the_lru(self):
+        fleet = make_fleet(replicas=1,
+                           cache=CacheConfig(capacity=16,
+                                             bypass_tiers=("batch",)))
+        try:
+            packed, players, ranks = boards(1, seed=9)
+            args = (packed[0], int(players[0]), int(ranks[0]))
+            for _ in range(2):
+                fleet.submit(*args, tier="batch").result(timeout=10)
+            s = fleet.cache.stats()
+            assert s["bypassed"] == 2
+            assert s["entries"] == 0 and s["hits"] == 0
+            assert fleet.stats()["boards"] == 2  # both really computed
+            fleet.submit(*args, tier="interactive").result(timeout=10)
+            assert fleet.cache.stats()["entries"] == 1
+        finally:
+            fleet.close()
+
+    def test_stats_and_health_carry_the_cache_block(self):
+        fleet = make_fleet(replicas=1, cache=CacheConfig(capacity=8))
+        try:
+            assert fleet.stats()["fleet"]["cache"]["capacity"] == 8
+            assert fleet.health()["cache"]["keying"] == "exact"
+        finally:
+            fleet.close()
+
+    def test_uncached_fleet_unchanged(self):
+        fleet = make_fleet(replicas=1)
+        try:
+            assert fleet.cache is None
+            assert "cache" not in fleet.stats()["fleet"]
+            packed, players, ranks = boards(1, seed=10)
+            args = (packed[0], int(players[0]), int(ranks[0]))
+            a = fleet.submit(*args).result(timeout=10)
+            b = fleet.submit(*args).result(timeout=10)
+            assert a == b
+            assert fleet.stats()["boards"] == 2
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# the CPU surge tier
+
+
+class TestSurgeTier:
+    def test_batch_prefers_cpu_interactive_prefers_accelerator(self):
+        tpu = ScriptedReplica(0, platform="tpu")
+        cpu = ScriptedReplica(1, platform="cpu")
+        for rep in (tpu, cpu):
+            rep.auto_value = np.float32(rep.idx)
+        fleet = scripted_fleet([tpu, cpu])
+        try:
+            packed, players, ranks = boards(8, seed=11)
+            for i in range(4):
+                args = (packed[i], int(players[i]), int(ranks[i]))
+                assert fleet.submit(*args, tier="batch") \
+                    .result(timeout=5) == np.float32(1)
+                assert fleet.submit(*args, tier="interactive") \
+                    .result(timeout=5) == np.float32(0)
+            assert len(cpu.futs) == 4 and len(tpu.futs) == 4
+        finally:
+            fleet.close()
+
+    def test_batch_falls_back_when_no_cpu_serves(self):
+        tpu = ScriptedReplica(0, platform="tpu")
+        tpu.auto_value = np.float32(0)
+        fleet = scripted_fleet([tpu])
+        try:
+            packed, players, ranks = boards(1, seed=12)
+            assert fleet.submit(packed[0], int(players[0]), int(ranks[0]),
+                                tier="batch").result(timeout=5) \
+                == np.float32(0)
+        finally:
+            fleet.close()
+
+    def test_interactive_fails_over_to_cpu_replica(self):
+        tpu = ScriptedReplica(0, platform="tpu")
+        cpu = ScriptedReplica(1, platform="cpu")
+        cpu.auto_value = np.float32(1)
+        tpu.fail_next = 10  # the accelerator is dead at submit time
+        fleet = scripted_fleet([tpu, cpu])
+        try:
+            packed, players, ranks = boards(1, seed=13)
+            got = fleet.submit(packed[0], int(players[0]), int(ranks[0]),
+                               tier="interactive").result(timeout=5)
+            assert got == np.float32(1)
+        finally:
+            fleet.close()
+
+    def test_ejection_baseline_is_platform_scoped(self):
+        cfg = FleetConfig(respawn_base_s=0.001, respawn_cap_s=0.005,
+                          eject_stragglers=True, eject_min_samples=4,
+                          eject_consecutive=1, eject_factor=3.0)
+        # a slow CPU replica among fast TPU peers: with a POOLED
+        # baseline it would be ejected for simply being a CPU; with the
+        # platform-scoped baseline it has no same-platform peer and is
+        # left alone
+        reps = [ScriptedReplica(0, platform="tpu"),
+                ScriptedReplica(1, platform="tpu"),
+                ScriptedReplica(2, platform="cpu")]
+        fleet = scripted_fleet(reps, fleet_config=cfg)
+        try:
+            for rep, lat in zip(fleet._replicas, (0.01, 0.01, 0.5)):
+                rep.lat.extend([lat] * 8)
+            fleet._eject_outliers()
+            assert fleet._ejections == 0
+            assert all(r.state == "serving" for r in fleet._replicas)
+        finally:
+            fleet.close()
+
+        # a straggler among SAME-platform peers is still ejected
+        reps = [ScriptedReplica(0, platform="cpu"),
+                ScriptedReplica(1, platform="cpu"),
+                ScriptedReplica(2, platform="cpu")]
+        fleet = scripted_fleet(reps, fleet_config=cfg)
+        try:
+            for rep, lat in zip(fleet._replicas, (0.01, 0.01, 0.5)):
+                rep.lat.extend([lat] * 8)
+            fleet._eject_outliers()
+            assert fleet._ejections == 1
+            assert fleet._replicas[2].state != "serving"
+        finally:
+            fleet.close()
+
+    def test_fleet_policy_engine_heterogeneous_platforms(self):
+        cfg = ModelConfig(num_layers=2, channels=8)
+        params = init(jax.random.key(0), cfg)
+        fleet = fleet_policy_engine(params, cfg, replicas=2, config=ECFG,
+                                    fleet=FAST_FLEET,
+                                    platforms=("tpu", "cpu"),
+                                    cache=CacheConfig(capacity=16))
+        try:
+            plats = [getattr(r.engine, "platform", None)
+                     for r in fleet._replicas]
+            assert plats == ["tpu", "cpu"]
+            detail = fleet.health()["replicas"]
+            assert [d.get("platform") for d in detail] == ["tpu", "cpu"]
+            packed, players, ranks = boards(2, seed=14)
+            row = fleet.submit(packed[0], int(players[0]),
+                               int(ranks[0]), tier="batch").result(30)
+            assert row.shape == (361,)
+            # kill the "tpu" replica: the CPU surge replica absorbs
+            # interactive traffic without losing an answer
+            assert fleet.eject_replica(0, reason="test-kill")
+            got = fleet.submit(packed[1], int(players[1]), int(ranks[1]),
+                               tier="interactive").result(30)
+            assert got.shape == (361,)
+            stats = fleet.stats()
+            assert {s.get("platform") for s in stats["replicas"]} \
+                == {"tpu", "cpu"}
+        finally:
+            fleet.close()
+
+    def test_platforms_reject_non_f32_variants(self):
+        cfg = ModelConfig(num_layers=2, channels=8)
+        params = init(jax.random.key(0), cfg)
+        with pytest.raises(ValueError):
+            fleet_policy_engine(params, cfg, replicas=2,
+                                platforms=("cpu",), variants=("int8",))
+
+
+# ---------------------------------------------------------------------------
+# surfaces: cli workload analyze --simulate-cache
+
+
+class TestSimulateCacheCli:
+    def test_cli_reports_achieved_hit_rate_per_size(self, tmp_path,
+                                                    capsys):
+        from deepgo_tpu import cli
+        from deepgo_tpu.serving import replay as rp
+
+        cap = str(tmp_path / "cap")
+        items = rp.build_synthetic_requests(SGF_DIR, requests=48, games=4,
+                                            opening_moves=4, seed=3)
+        rp.write_synthetic_capture(cap, items)
+        cli.main(["workload", "analyze", cap, "--simulate-cache", "1,256",
+                  "--json"])
+        data = json.loads(capsys.readouterr().out)
+        sim = data["simulated_cache"]
+        assert set(sim) == {"1", "256"}
+        for size in sim:
+            for keying in ("exact", "canonical"):
+                assert 0.0 <= sim[size][keying]["hit_rate"] <= 1.0
+        # an unbounded cache achieves exactly the projection
+        assert sim["256"]["exact"]["hit_rate"] \
+            == pytest.approx(data["projected_hit_rate"], abs=1e-4)
+        assert sim["256"]["exact"]["hits"] >= sim["1"]["exact"]["hits"]
+        cli.main(["workload", "analyze", cap, "--simulate-cache", "256"])
+        out = capsys.readouterr().out
+        assert "simulated cache" in out
