@@ -1,7 +1,8 @@
 # Developer entry points. `scripts/setup.sh` chains native + data + test.
 
 .PHONY: native data test test-full lint verify verify-faults verify-serving \
-    verify-resilience verify-fleet verify-distributed verify-obs \
+    verify-resilience verify-fleet verify-distributed verify-remesh \
+    verify-obs \
     verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
     verify-cost verify-quant verify-telemetry verify-workload \
     verify-chaos verify-cache bench bench-gate smoke clean
@@ -40,6 +41,9 @@ verify-distributed:  # multi-host elastic: liveness, deadlines, subprocess chaos
 	JAX_PLATFORMS=cpu python -m pytest tests/test_liveness.py \
 	    tests/test_deadlines.py tests/test_elastic.py \
 	    tests/test_distributed.py tests/test_watchdog.py -q
+
+verify-remesh:  # reshard-on-remesh: save/restore round-trips across every dp x tp layout on 8 virtual devices, corrupt-manifest refusal, per_host_batch rebalance matrix, fault sites, slow tp-crossing SIGKILL chaos recovery
+	JAX_PLATFORMS=cpu python -m pytest tests/test_reshard.py -q
 
 verify-obs:  # observability: registry concurrency, exporter round-trip, spans, rotation
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
@@ -81,7 +85,7 @@ verify-chaos:  # chaos campaigns: fault-kind/scenario/hedging/ejection/canary su
 verify-cache:  # position cache: shared digest/augment table pinning, canonical-hit bitwise remap (all 8 views), coalescing + leader-failure promotion, reload invalidation zero-stale, surge-tier routing, cli --simulate-cache
 	JAX_PLATFORMS=cpu python -m pytest tests/test_cache.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload verify-chaos verify-cache  # the full failure-model suite
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-remesh verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload verify-chaos verify-cache  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -668,6 +668,33 @@ def _apply_gate(result: dict, args) -> None:
     # when the gate itself skipped (device mismatch / no baseline) —
     # cross-device MFU ratios are no more a regression than cross-device
     # throughput ratios (obs/costmodel.evaluate_mfu_floor).
+    # the distributed chaos leg's steps-lost folds in next to its gated
+    # recovery latency: a recovery that got "faster" by rolling back
+    # further is not a win. Quantized by the checkpoint cadence, so the
+    # comparison allows one checkpoint window of slack; baselines from
+    # before the chaos leg existed skip rather than fail.
+    if (result.get("metric") == "distributed_elastic_recovery_latency_s"
+            and result.get("steps_lost") is not None):
+        base_lost = (entry or {}).get("steps_lost")
+        fold = {"value": result["steps_lost"], "baseline": base_lost}
+        if base_lost is None:
+            fold["verdict"] = "skip"
+            fold["reason"] = "no steps_lost in the last-good record"
+        elif result["steps_lost"] > base_lost + DIST_CKPT_INTERVAL:
+            fold["verdict"] = "fail"
+            fold["reason"] = (f"steps lost {result['steps_lost']} vs "
+                              f"baseline {base_lost} (+{DIST_CKPT_INTERVAL} "
+                              f"checkpoint-window slack) — recovery rolls "
+                              f"back further than it used to")
+        else:
+            fold["verdict"] = "pass"
+        result["gate"]["steps_lost"] = fold
+        if fold["verdict"] == "fail" \
+                and result["gate"].get("verdict") != "fail":
+            result["gate"].update(
+                verdict="fail",
+                reason=f"{fold['reason']} "
+                       f"(was: {result['gate'].get('reason')})")
     if result["gate"].get("verdict") != "skip":
         from deepgo_tpu.obs.costmodel import evaluate_mfu_floor
 
@@ -713,6 +740,11 @@ DEFAULT_FLEET_FAULTS = "serving_dispatch:fail@4,fleet_route:transient@2"
 # kill-and-resume test uses)
 DEFAULT_DIST_FAULTS = "kill:step@7"
 
+# the distributed bench's checkpoint cadence (validation_interval below):
+# steps-lost is quantized by it — detection lands somewhere between two
+# checkpoints — so the gate fold allows one window of slack vs baseline
+DIST_CKPT_INTERVAL = 20
+
 # default --mode loop chaos: one kill per component class — an actor (the
 # 2nd buffer ingest raises), the learner (the 6th training step raises,
 # mid-window, forcing a cursor-pinned bit-exact resume), the gatekeeper
@@ -725,18 +757,21 @@ DEFAULT_LOOP_FAULTS = ("loop_ingest:fail@2,train_step:fail@6,"
 
 
 def _bench_distributed(faults_spec: str | None = None) -> dict:
-    """2-host elastic training chaos run (CPU subprocesses, simulated hosts).
+    """2-host elastic training chaos run (CPU subprocesses, simulated
+    hosts) under the composed dp=2 × tp=2 × ZeRO mesh.
 
-    Spawns two ``cli train --elastic`` hosts over a shared run directory
-    (the subprocess harness the slow test in tests/test_elastic.py drives;
+    Spawns two ``cli train --elastic --reshard`` hosts over a shared run
+    directory (the subprocess harness the slow tests in
+    tests/test_elastic.py and tests/test_reshard.py drive;
     docs/robustness.md "Distributed failure domains"). With ``faults_spec``
     the victim host gets it as DEEPGO_FAULTS — the default SIGKILLs the
     victim mid-training — and the headline value is the survivor's measured
     RECOVERY LATENCY (last beat of the dead host -> training resumed from
-    the converged checkpoint), with steps-lost and heartbeat counters
-    alongside. Without faults it is the clean 2-host elastic run: value is
-    the survivor's samples/sec, i.e. the elastic layer's overhead measured
-    rather than guessed.
+    the converged checkpoint), with steps-lost, the tp shrink the reshard
+    layer performed (tp_from/tp_to), and its sharding-claim findings count
+    alongside. Without faults it is the clean 2-host composed-mesh run:
+    value is the survivor's samples/sec, i.e. the elastic layer's overhead
+    measured rather than guessed.
 
     Deliberately CPU: this container's backend has no cross-process
     collectives, and the machinery under test — liveness, convergence,
@@ -758,36 +793,46 @@ def _bench_distributed(faults_spec: str | None = None) -> dict:
                              os.path.join(data_root, split),
                              workers=1, verbose=False)
         run_dir = os.path.join(tmp, "run")
-        iters = 240
+        # the chaos leg needs post-kill runway: once the victim dies the
+        # survivor roughly doubles its step rate (the two simulated hosts
+        # share this CPU), and it must still be mid-run when the victim's
+        # 12s silence budget expires or no recovery is ever observed
+        iters = 480 if faults_spec else 240
         # checkpoints every 20 steps but liveness windows every 5: detection
         # usually lands BETWEEN checkpoints, so the steps-lost counter
         # measures the real rollback cost instead of a structural zero
         sets = [
             "name=dist-bench", "num_layers=2", "channels=8", "batch_size=8",
-            "rate=0.05", "validation_size=16", "validation_interval=20",
+            "rate=0.05", "validation_size=16",
+            f"validation_interval={DIST_CKPT_INTERVAL}",
             "print_interval=5", f"data_root={data_root}",
             "train_split=validation", "validation_split=test",
-            "loader_threads=0", "data_parallel=2", "keep_checkpoints=0",
+            "loader_threads=0", "data_parallel=2", "tensor_parallel=2",
+            "keep_checkpoints=0",
         ]
         env = {k: v for k, v in os.environ.items()
                if k not in ("DEEPGO_FAULTS", "XLA_FLAGS", "PYTHONPATH")}
         env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        # 4 virtual devices per simulated host: the composed 2x2 mesh,
+        # with headroom for the post-loss reshard to dp=2 x tp=1
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
         procs = []
         for host in (0, 1):
             henv = dict(env)
             if faults_spec and host == 1:
                 henv["DEEPGO_FAULTS"] = faults_spec
             cmd = [sys.executable, "-m", "deepgo_tpu.cli", "train",
-                   "--iters", str(iters), "--elastic",
+                   "--iters", str(iters), "--elastic", "--reshard",
                    "--auto-resume", run_dir,
                    "--process-id", str(host), "--expected-hosts", "2",
-                   # the silence budget (interval x budget = 3s) must
-                   # comfortably cover a validation + checkpoint window
-                   # (which includes the one-off eval-step compile), or a
-                   # busy host reads as dead — the clean run would then
-                   # report phantom recoveries
-                   "--heartbeat-interval", "0.5", "--miss-budget", "6",
+                   # the silence budget (interval x budget = 12s) must
+                   # comfortably cover the composed-mesh first-step
+                   # compile (~8s on CPU; beats ride the window cadence,
+                   # so a still-compiling peer is silent that long) plus
+                   # a validation + checkpoint window, or a busy host
+                   # reads as dead — the clean run would then report
+                   # phantom recoveries
+                   "--heartbeat-interval", "0.5", "--miss-budget", "24",
                    "--init-deadline", "120", "--step-deadline", "300",
                    "--set", *sets]
             procs.append(subprocess.Popen(
@@ -844,6 +889,10 @@ def _bench_distributed(faults_spec: str | None = None) -> dict:
                 "steps_lost": summary["steps_lost_total"],
                 "detect_latency_s": (round(recs[-1]["detect_latency_s"], 3)
                                      if recs else None),
+                "tp_from": recs[-1].get("tp_from") if recs else None,
+                "tp_to": recs[-1].get("tp_to") if recs else None,
+                "sharding_findings": (recs[-1].get("sharding_findings")
+                                      if recs else None),
                 "final_step": summary["final_step"],
                 "survivor_samples_per_sec": round(
                     summary.get("samples_per_sec", 0.0), 1),

@@ -122,10 +122,12 @@ class LintConfig:
     # documented next to them) in ISSUE 14; workload_* (the workload
     # observatory capture streams: request/position/capture-summary
     # records) in ISSUE 15; cache_* (the position cache's invalidation
-    # event) in ISSUE 17.
+    # event) in ISSUE 17; reshard_* (the resharding restore's event
+    # stream next to the deepgo_reshard_* metrics) in ISSUE 18.
     grammar_prefixes: tuple = ("deepgo_", "obs_", "loop_", "fleet_",
                                "trace_", "lineage_", "cost_", "ts_",
-                               "anomaly_", "workload_", "cache_")
+                               "anomaly_", "workload_", "cache_",
+                               "reshard_")
     # doc tokens that share a grammar prefix but are not metrics/events:
     # bench JSON keys and similar
     grammar_ignore: frozenset = frozenset({

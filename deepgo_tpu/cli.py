@@ -91,6 +91,13 @@ def parse_overrides(pairs: list[str]) -> dict:
     return out
 
 
+def _train_overrides(args) -> dict:
+    overrides = parse_overrides(args.set)
+    if args.tp is not None:
+        overrides["tensor_parallel"] = args.tp  # shorthand for --set
+    return overrides
+
+
 def cmd_train(args) -> None:
     if args.resume and args.auto_resume:
         raise SystemExit("--resume and --auto-resume are mutually exclusive")
@@ -116,12 +123,13 @@ def cmd_train(args) -> None:
             init_deadline_s=args.init_deadline,
             step_deadline_s=args.step_deadline,
             max_recoveries=args.max_recoveries,
+            reshard=args.reshard,
             coordinator=args.coordinator,
             num_processes=args.num_processes,
             obs_port=args.obs_port,
         )
         summary = run_elastic(args.auto_resume, args.iters,
-                              overrides=parse_overrides(args.set), ecfg=ecfg)
+                              overrides=_train_overrides(args), ecfg=ecfg)
         print(f"elastic host {ecfg.process_id} done at step "
               f"{summary['final_step']} ({summary['recoveries']} recoveries, "
               f"{summary['steps_lost_total']} steps rolled back)")
@@ -132,7 +140,7 @@ def cmd_train(args) -> None:
         # converges on the same final state as one uninterrupted run
         # (docs/robustness.md)
         exp = Experiment.auto_resume(args.auto_resume,
-                                     overrides=parse_overrides(args.set))
+                                     overrides=_train_overrides(args))
         if exp.step > 0:
             print(f"auto-resumed {exp.id} at step {exp.step}")
         else:
@@ -148,7 +156,7 @@ def cmd_train(args) -> None:
         print(f"resumed {exp.id} at step {exp.step}")
         iters = args.iters
     else:
-        config = ExperimentConfig(**parse_overrides(args.set))
+        config = ExperimentConfig(**_train_overrides(args))
         exp = Experiment(config)
         print(f"experiment {exp.id}")
         iters = args.iters
@@ -868,7 +876,20 @@ def main(argv=None) -> None:
                    help="multi-host elastic mode (requires --auto-resume): "
                         "heartbeat liveness, deadline-wrapped bootstrap, "
                         "checkpoint-coordinated re-mesh recovery on host "
-                        "loss (docs/robustness.md)")
+                        "loss over the composed dp×tp×ZeRO mesh; combine "
+                        "with --tp/--reshard for tp-crossing recovery "
+                        "(docs/robustness.md)")
+    p.add_argument("--tp", type=int, default=None, metavar="N",
+                   help="tensor-parallel factor of the mesh (shorthand for "
+                        "--set tensor_parallel=N): conv channels shard "
+                        "over the \"model\" axis, composing with data "
+                        "parallelism and ZeRO optimizer-state sharding")
+    p.add_argument("--reshard", action="store_true",
+                   help="(--elastic) let recovery SHRINK the tp factor "
+                        "with the surviving fraction and reshard the "
+                        "checkpoint state into the new dp×tp×ZeRO layout "
+                        "(parallel/reshard.py); without it a re-mesh "
+                        "keeps the stored tp")
     p.add_argument("--process-id", type=int, default=0,
                    help="(--elastic) this host's id in [0, expected-hosts)")
     p.add_argument("--expected-hosts", type=int, default=1,

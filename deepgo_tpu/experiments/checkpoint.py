@@ -27,6 +27,11 @@ Format v2 adds the crash-safety layer (docs/robustness.md):
     skipping corrupt candidates with a logged reason (elastic
     auto-resume).
 
+Metas may additionally carry a ``mesh`` manifest (parallel/reshard.py):
+the dp×tp grid that wrote the file plus per-leaf partition specs.
+``validate_manifest`` structurally refuses a corrupt one on the verify
+path — the integrity block covers array payloads, not the meta member.
+
 v1 files (no integrity block) still load; they just can't be verified.
 All validation failures raise :class:`CheckpointError` (never ``assert``,
 which vanishes under ``python -O``) carrying the offending path.
@@ -129,6 +134,42 @@ def _verify_integrity(path: str, meta: dict, arrays: dict) -> None:
                   "file (auto-resume skips it automatically)")
 
 
+def validate_manifest(manifest, path: str, *, n_params: int | None = None,
+                      n_opt: int | None = None) -> None:
+    """Structural validation of the ``mesh`` manifest (parallel/reshard.py)
+    carried in v2+ metas. The integrity block covers array payloads, not
+    the meta member itself, so a corrupt manifest must be refused here —
+    as a :class:`CheckpointError`, which makes ``find_latest_valid`` skip
+    the file exactly like bit rot in a weight array."""
+    if not isinstance(manifest, dict):
+        raise CheckpointError(
+            path, f"mesh manifest is {type(manifest).__name__}, not a dict "
+                  f"— corrupt meta")
+    for key in ("data", "model", "devices"):
+        val = manifest.get(key)
+        if not isinstance(val, int) or isinstance(val, bool) or val < 1:
+            raise CheckpointError(
+                path, f"mesh manifest {key}={val!r} is not a positive int "
+                      f"— corrupt meta")
+    if manifest["data"] * manifest["model"] != manifest["devices"]:
+        raise CheckpointError(
+            path, f"mesh manifest inconsistent: data={manifest['data']} × "
+                  f"model={manifest['model']} != devices="
+                  f"{manifest['devices']}")
+    for key, want in (("params", n_params), ("opt_state", n_opt)):
+        specs = manifest.get(key)
+        if (not isinstance(specs, list)
+                or not all(isinstance(s, str) for s in specs)):
+            raise CheckpointError(
+                path, f"mesh manifest {key} specs are not a list of "
+                      f"partition-spec strings — corrupt meta")
+        if want is not None and len(specs) != want:
+            raise CheckpointError(
+                path, f"mesh manifest lists {len(specs)} {key} specs but "
+                      f"the checkpoint stores {want} arrays — spliced or "
+                      f"corrupt meta")
+
+
 # ---- save / load ----
 
 
@@ -210,6 +251,9 @@ def load_checkpoint(path: str, verify: bool = True):
         arrays = {k: _read_member(z, k, path) for k in (*p_keys, *o_keys)}
     if verify:
         _verify_integrity(path, meta, arrays)
+        if "mesh" in meta:  # pre-reshard checkpoints have no manifest
+            validate_manifest(meta["mesh"], path,
+                              n_params=len(p_keys), n_opt=len(o_keys))
     return meta, [arrays[k] for k in p_keys], [arrays[k] for k in o_keys]
 
 

@@ -35,6 +35,7 @@ from ..data.loader import AsyncLoader
 from ..models import policy_cnn
 from ..obs import JsonlSink, get_registry, span, trace_to
 from ..parallel import data_sharding, make_mesh, replicated_sharding
+from ..parallel import reshard
 from ..training import make_eval_step, make_train_step, make_train_step_many
 from ..training.optimizers import OPTIMIZERS
 from ..utils import MetricsWriter, append_registry, git_sha
@@ -106,6 +107,11 @@ class ExperimentConfig:
     # parallelism (mesh axes; reference analogue: numGPUs, experiments.lua:10)
     data_parallel: int = 0  # 0 = all available devices
     tensor_parallel: int = 1
+    # ZeRO-1 optimizer-state sharding over "data" (parallel/zero.py,
+    # arXiv:2004.13336), composed with the tp placement — on by default:
+    # placement-only, bitwise-neutral, and survives re-meshes through the
+    # reshard layer (parallel/reshard.py)
+    zero_opt: bool = True
     expand_backend: str = "xla"  # "xla" | "pallas" | "auto"
     # identity / observability
     seed: int = 0
@@ -172,6 +178,11 @@ class Experiment:
         self.initialized = False
         self.params = None
         self.opt_state = None
+        # sharding-claim findings from the most recent resharding restore
+        # (Experiment.load); [] for a fresh run — the elastic recovery
+        # record reports this count so a silent replicated-instead-of-
+        # sharded restore is visible in the run's JSONL
+        self.last_restore_findings: list = []
         # optional window hook for the elastic layer: called at every
         # print-window boundary (AFTER metrics/validation/checkpointing)
         # with (step, window_seconds, window_steps); an exception raised
@@ -209,10 +220,18 @@ class Experiment:
             self.optimizer = opt_fn(cfg.rate)
         if self.params is None:
             self.params = policy_cnn.init(jax.random.key(cfg.seed), self.model_cfg)
-            self.opt_state = self.optimizer.init(self.params)
+        # composed dp×tp×ZeRO placement (parallel/reshard.py): params are
+        # placed FIRST, then the optimizer state is created from the
+        # *placed* params — zeros_like inherits the "model" placement, so
+        # zero_sharding merges "data" in on top of it instead of fighting it
+        self.params, self.opt_state = reshard.place_state(
+            self.params, self.opt_state, self.mesh,
+            tensor_parallel=cfg.tensor_parallel, zero_opt=cfg.zero_opt)
+        if self.opt_state is None:
+            _, self.opt_state = reshard.place_state(
+                self.params, self.optimizer.init(self.params), self.mesh,
+                tensor_parallel=cfg.tensor_parallel, zero_opt=cfg.zero_opt)
         rep = replicated_sharding(self.mesh)
-        self.params = jax.device_put(self.params, rep)
-        self.opt_state = jax.device_put(self.opt_state, rep)
         anchor = None
         if bool(cfg.anchor_checkpoint) != (cfg.anchor_weight > 0):
             # config validation must survive `python -O`, so no assert: a
@@ -689,6 +708,10 @@ class Experiment:
             "last_loss": self.last_loss,
             "config": self.config.to_dict(),
             "git_sha": git_sha(),
+            # which mesh wrote this file and where each leaf lived —
+            # restore under any other layout reshards (parallel/reshard.py)
+            "mesh": reshard.manifest(self.mesh, self.params, self.opt_state,
+                                     zero_opt=self.config.zero_opt),
         }
         ckpt.save_checkpoint(path, self.params, self.opt_state, meta)
         if managed:
@@ -749,31 +772,38 @@ class Experiment:
                     pass
 
     @classmethod
-    def load(cls, path: str) -> "Experiment":
+    def load(cls, path: str, remesh: dict | None = None) -> "Experiment":
         """Rebuild an experiment from a checkpoint and continue
-        (reference Experiment:load + unpickle, experiments.lua:65-72,129-131)."""
+        (reference Experiment:load + unpickle, experiments.lua:65-72,129-131).
+
+        ``remesh`` overrides the stored parallelism layout — e.g.
+        ``{"tensor_parallel": 1}`` restores a tp=2 checkpoint onto a tp=1
+        mesh. The restore routes through the resharding layer
+        (parallel/reshard.py): checkpoint leaves are re-scattered into
+        exactly the placement a fresh ``init()`` under the new layout
+        produces, and the sharding-claim findings from that restore land
+        on ``exp.last_restore_findings``."""
         meta, p_leaves, o_leaves = ckpt.load_checkpoint(path)
         config = ExperimentConfig.from_dict(meta["config"])
+        if remesh:
+            config = config.replace(**remesh)
         exp = cls(config, run_id=meta["id"])
         exp.step = meta["step"]
         exp.validation_history = list(meta["validation_history"])
         exp.ewma = meta.get("ewma")
         last_loss = meta.get("last_loss")
         exp.last_loss = float("nan") if last_loss is None else last_loss
-        exp.init()
-        exp.params = jax.device_put(
+        exp.init()  # placed templates under the (possibly different) mesh
+        p_sh, o_sh = reshard.state_shardings(exp.params, exp.opt_state)
+        exp.params, exp.opt_state, exp.last_restore_findings = reshard.restore(
             ckpt.unflatten_like(exp.params, p_leaves, path),
-            replicated_sharding(exp.mesh),
-        )
-        exp.opt_state = jax.device_put(
             ckpt.unflatten_like(exp.opt_state, o_leaves, path),
-            replicated_sharding(exp.mesh),
-        )
+            p_sh, o_sh)
         return exp
 
     @classmethod
     def auto_resume(cls, run_dir: str, overrides: dict | None = None,
-                    log=None) -> "Experiment":
+                    log=None, remesh: dict | None = None) -> "Experiment":
         """Elastic resume: continue from the newest *valid* checkpoint in
         ``run_dir`` (corrupt/truncated candidates are skipped with a
         logged reason), or start a fresh run rooted at exactly that
@@ -781,15 +811,18 @@ class Experiment:
         ``cli train --auto-resume <run_dir>`` survives any number of
         kills. On resume the stored config wins over ``overrides``: the
         bit-exact continuation guarantee is only meaningful against the
-        configuration the run actually started with."""
+        configuration the run actually started with. ``remesh`` is the
+        one sanctioned exception — a parallelism-layout change (e.g.
+        shrinking tp after losing hosts) applied through the resharding
+        restore, never silently."""
         path = ckpt.find_latest_valid(run_dir, log=log)
         if path is not None:
             if overrides:
                 print(f"auto-resume: ignoring overrides {sorted(overrides)} "
                       f"(config comes from {path})", file=sys.stderr)
-            return cls.load(path)
+            return cls.load(path, remesh=remesh)
         run_dir = run_dir.rstrip("/")
         parent, run_id = os.path.split(run_dir)
-        config = ExperimentConfig(**(overrides or {}))
+        config = ExperimentConfig(**{**(overrides or {}), **(remesh or {})})
         config = config.replace(run_dir=parent or ".")
         return cls(config, run_id=run_id or None)

@@ -13,7 +13,14 @@ when a participating host dies, every survivor independently
      the checkpoint is the only state they provably share;
   3. **re-meshes** over the surviving process set (``remesh``) and
      re-balances the global batch (``per_host_batch`` with the surviving
-     count);
+     count). With ``ElasticConfig.reshard`` the re-mesh may *change the
+     tp factor* (``shrink_tp`` scales "model" down with the surviving
+     fraction): the converge step then routes through the resharding
+     restore (parallel/reshard.py) — checkpoint leaves re-scatter into
+     the new composed dp×tp×ZeRO placement, with the sharding-claim
+     checker armed for the duration so a silent replicated-instead-of-
+     sharded restore is a recorded finding, and ``per_host_batch`` is
+     re-derived against the new data width;
   4. **resumes** — and because the synchronous data stream is
      step-indexed (``loader.step_rng``: the batch for step t is a pure
      function of (seed, t)), the continuation is bit-exact against an
@@ -73,6 +80,11 @@ class ElasticConfig:
     init_deadline_s: float = 120.0
     step_deadline_s: float = 0.0   # 0 = no watchdog around the first step
     max_recoveries: int = 8
+    # allow recovery to shrink the tensor-parallel factor with the
+    # surviving fraction (shrink_tp) and reshard the checkpoint state
+    # into the new layout; off = re-mesh keeps the stored tp (a loss
+    # that strands too few devices for it then surfaces as ConfigError)
+    reshard: bool = False
     coordinator: str | None = None
     num_processes: int | None = None
     heartbeat_dir: str = ""        # default: <run_dir>/heartbeats
@@ -95,6 +107,21 @@ def remesh(n_model: int, survivors: set[int]):
         alive = sorted(p for p in survivors if p < jax.process_count())
         return hybrid_mesh(n_model, processes=alive)
     return hybrid_mesh(n_model)
+
+
+def shrink_tp(n_model: int, alive: int, expected: int) -> int:
+    """Target tensor-parallel factor after shrinking to ``alive`` of
+    ``expected`` hosts: scale "model" down with the surviving fraction,
+    rounded down to the nearest divisor of the original factor (a
+    non-divisor would split the already-partitioned channel dims
+    unevenly). Never below 1 — a single survivor still trains, fully
+    replicated."""
+    if n_model <= 1 or alive >= expected:
+        return max(1, n_model)
+    target = max(1, (n_model * alive) // max(1, expected))
+    while n_model % target:
+        target -= 1
+    return target
 
 
 def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None,
@@ -178,6 +205,10 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
 
     recoveries: list[dict] = []
     pending_loss: dict | None = None
+    # parallelism-layout override for the converge step, set by the
+    # HostLost handler when ecfg.reshard shrinks tp; sticky across
+    # further losses (later checkpoints carry the new layout anyway)
+    remesh_overrides: dict | None = None
     exp = None
     # fresh starts must record that this run is elastic (the flag rides in
     # the checkpoint config and threads the dist_collective fault site
@@ -186,8 +217,27 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
     overrides["elastic"] = True
     try:
         while True:
-            exp = Experiment.auto_resume(run_dir, overrides=dict(overrides),
-                                         log=log)
+            if pending_loss is not None:
+                # post-loss converge: arm the sharding-claim checker for
+                # the duration of the resharding restore — "recovered
+                # onto the new mesh" must mean verifiably placed, not
+                # silently replicated (docs/robustness.md)
+                from ..analysis import xlacheck
+
+                xlacheck.enable(True)
+                try:
+                    exp = Experiment.auto_resume(
+                        run_dir, overrides=dict(overrides), log=log,
+                        remesh=remesh_overrides)
+                finally:
+                    xlacheck.enable(None)
+                metrics.write("reshard_restore", host=ecfg.process_id,
+                              tp=exp.config.tensor_parallel,
+                              findings=len(exp.last_restore_findings))
+            else:
+                exp = Experiment.auto_resume(run_dir,
+                                             overrides=dict(overrides),
+                                             log=log)
             if pending_loss is not None:
                 # finalize the recovery record now that we know where the
                 # fleet converged (the checkpoint step survives; everything
@@ -200,6 +250,8 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
                     recovery_latency_s=now - rec["last_seen"],
                     detect_latency_s=rec["detected_at"] - rec["last_seen"],
                     survivors=sorted(survivors),
+                    tp=exp.config.tensor_parallel,
+                    sharding_findings=len(exp.last_restore_findings),
                 )
                 del rec["detected_at"]
                 recoveries.append(rec)
@@ -275,8 +327,23 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
                 log(f"elastic host {ecfg.process_id}: {e}; converging on the "
                     f"latest valid checkpoint and re-meshing over "
                     f"{sorted(survivors)}")
-                mesh = remesh(exp.config.tensor_parallel, survivors)
+                tp_from = exp.config.tensor_parallel
+                new_tp = tp_from
+                if ecfg.reshard:
+                    new_tp = shrink_tp(tp_from, len(survivors),
+                                       ecfg.expected_hosts)
+                    if new_tp != tp_from:
+                        remesh_overrides = {"tensor_parallel": new_tp}
+                        log(f"elastic host {ecfg.process_id}: resharding "
+                            f"tp {tp_from} -> {new_tp} over the survivors")
+                        metrics.write("elastic_remesh", host=ecfg.process_id,
+                                      tp_from=tp_from, tp_to=new_tp,
+                                      survivors=sorted(survivors))
+                mesh = remesh(new_tp, survivors)
                 try:
+                    # re-derived after EVERY re-mesh: the data width the
+                    # global batch must divide over is a property of the
+                    # new mesh, not the original launch
                     local_batch = per_host_batch(exp.config.batch_size,
                                                  process_count=len(survivors))
                     log(f"elastic host {ecfg.process_id}: re-mesh "
@@ -297,6 +364,8 @@ def run_elastic(run_dir: str, total_iters: int, *, overrides: dict | None = None
                     "step_at_detection": exp.step,
                     "detected_at": detected_at,
                     "per_host_batch": local_batch,
+                    "tp_from": tp_from,
+                    "tp_to": new_tp,
                 }
                 metrics.write("host_lost", **{k: v for k, v in
                                               pending_loss.items()

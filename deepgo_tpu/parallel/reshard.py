@@ -1,0 +1,200 @@
+"""Resharding checkpoint layer: restore training state under a *different*
+mesh than the one that saved it (ROADMAP item 3's tp-crossing recovery).
+
+Checkpoints already store fully-gathered host arrays (save_checkpoint
+``np.asarray``s every leaf), so a checkpoint is layout-free by
+construction; what was missing is the *contract* around putting those
+values back under a new dp×tp×ZeRO layout. This module owns that
+contract:
+
+  * **manifest** — every checkpoint's meta carries a ``mesh`` block next
+    to the v2 CRC/SHA integrity block: the (data, model) grid that wrote
+    it, whether ZeRO was on, and the per-leaf partition specs of params
+    and optimizer state. ``checkpoint.validate_manifest`` refuses a
+    structurally corrupt manifest as a :class:`CheckpointError`, so
+    ``find_latest_valid`` skips it like any other corruption.
+  * **gather / scatter** — the two halves of a reshard.
+    :func:`gather_to_host` materializes device leaves as host arrays
+    (the save path and the planned in-process remesh);
+    :func:`scatter` places host leaves under the target mesh's composed
+    shardings. Both are ``DEEPGO_FAULTS`` sites (``reshard_gather`` /
+    ``reshard_scatter``) wrapped in bounded full-jitter retry —
+    transient storage/relay hiccups are absorbed, hard faults surface
+    typed. The ``reshard_collective`` site covers the cross-host
+    convergence barrier (slow@MS emulates a collective timeout; the
+    same bounded retry bounds it).
+  * **value preservation** — a reshard is bitwise: gather + scatter
+    never touch array contents, only placement. What a tp change DOES
+    alter is the accumulation order of *subsequent* steps (XLA splits
+    the out-channel reduction in the conv backward across "model"), so
+    the bit-exact recovery contract is stated against a reference run
+    performing the same planned remesh at the same step — the slow
+    chaos test in tests/test_reshard.py asserts exactly that, and
+    :func:`composed_shardings` is what both sides share.
+
+Placement policy (the composed first-class path): params channel-shard
+over "model" when ``tensor_parallel > 1`` (parallel/tensor.py), the
+optimizer state additionally ZeRO-1-shards over "data" on its first free
+divisible dim (parallel/zero.py, arXiv:2004.13336) — ZeRO placement is
+bitwise-neutral, so it is on by default. Every restore re-verifies the
+live placement with the sharding-claim checker
+(analysis/xlacheck.check_sharding): "resharded" silently meaning
+"replicated" is a recorded finding, not a guess.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..utils import faults
+from ..utils.retry import retry_with_backoff
+from .mesh import replicated_sharding
+from .tensor import param_shardings
+from .zero import shard_opt_state
+
+MANIFEST_VERSION = 1
+
+
+def _registry():
+    from ..obs import get_registry
+
+    return get_registry()
+
+
+def composed_shardings(params, mesh: Mesh, *, tensor_parallel: int):
+    """The params half of the composed placement: channel-sharded over
+    "model" when tensor parallelism is on, replicated otherwise. (The
+    optimizer half is derived from the *placed* params via
+    ``zero_sharding`` so ZeRO merges "data" in without resharding
+    "model" away — see :func:`place_state`.)"""
+    if tensor_parallel > 1:
+        return param_shardings(params, mesh)
+    rep = replicated_sharding(mesh)
+    return jax.tree.map(lambda _: rep, params)
+
+
+def place_state(params, opt_state, mesh: Mesh, *, tensor_parallel: int,
+                zero_opt: bool):
+    """Place a (params, opt_state) pair under the composed dp×tp×ZeRO
+    policy. ``opt_state`` may be None, in which case the caller creates
+    it from the placed params (optimizer.init inherits the params
+    placement via zeros_like, which is what lets ZeRO compose)."""
+    params = jax.device_put(
+        params, composed_shardings(params, mesh,
+                                   tensor_parallel=tensor_parallel))
+    from ..analysis import xlacheck
+
+    if tensor_parallel > 1:
+        xlacheck.check_sharding(
+            "tensor.params", params,
+            composed_shardings(params, mesh, tensor_parallel=tensor_parallel))
+    if opt_state is None:
+        return params, None
+    if zero_opt:
+        opt_state = shard_opt_state(opt_state, mesh)
+    else:
+        opt_state = jax.device_put(opt_state, replicated_sharding(mesh))
+    return params, opt_state
+
+
+def state_shardings(params, opt_state):
+    """Read the live placement off a placed state — the sharding pytrees
+    a restore scatters into (restored leaves land exactly where freshly
+    initialized ones did)."""
+    return (jax.tree.map(lambda l: l.sharding, params),
+            jax.tree.map(lambda l: l.sharding, opt_state))
+
+
+def _spec_str(leaf) -> str:
+    sh = getattr(leaf, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    return str(spec) if spec is not None else "host"
+
+
+def manifest(mesh: Mesh, params, opt_state, *, zero_opt: bool) -> dict:
+    """The mesh/sharding manifest a checkpoint's meta carries: which grid
+    wrote it and where every leaf lived. Restore does NOT replay these
+    specs (the target mesh derives its own); they make the layout change
+    auditable (``elastic_remesh`` events name from/to) and structurally
+    verifiable (``checkpoint.validate_manifest``)."""
+    return {
+        "version": MANIFEST_VERSION,
+        "data": int(mesh.shape["data"]),
+        "model": int(mesh.shape["model"]),
+        "devices": int(mesh.shape["data"] * mesh.shape["model"]),
+        "zero_opt": bool(zero_opt),
+        "params": [_spec_str(l) for l in jax.tree.leaves(params)],
+        "opt_state": [_spec_str(l) for l in jax.tree.leaves(opt_state)],
+    }
+
+
+def gather_to_host(tree):
+    """Materialize every leaf as a host array — the gather half of a
+    reshard. A ``DEEPGO_FAULTS`` site with bounded full-jitter retry:
+    transient faults (flaky storage, a relay drop mid-gather) are
+    absorbed; hard faults surface typed."""
+
+    def gather():
+        faults.check("reshard_gather")
+        faults.maybe_slow("reshard_gather")
+        # lint: allow[hot-sync] the reshard gather IS the declared materialization point — recovery path, no pipeline to stall
+        return jax.tree.map(np.asarray, tree)
+
+    t0 = time.monotonic()
+    out = retry_with_backoff(gather, attempts=4, base_delay=0.05,
+                             jitter=True)
+    _registry().histogram(
+        "deepgo_reshard_gather_seconds",
+        "host-gather time of one reshard (params + optimizer state)",
+    ).observe(time.monotonic() - t0)
+    return out
+
+
+def scatter(tree, shardings):
+    """Place host leaves under the target shardings — the re-scatter half
+    of a reshard. Same fault-site + bounded full-jitter retry contract
+    as the gather; the ``reshard_collective`` barrier site covers the
+    cross-host convergence this scatter is part of (a slow@MS spec
+    emulates a collective timeout without killing anything)."""
+
+    def place():
+        faults.check("reshard_scatter")
+        faults.check("reshard_collective")
+        faults.maybe_slow("reshard_scatter")
+        faults.maybe_slow("reshard_collective")
+        return jax.tree.map(
+            lambda leaf, s: jax.device_put(leaf, s), tree, shardings)
+
+    t0 = time.monotonic()
+    out = retry_with_backoff(place, attempts=4, base_delay=0.05,
+                             jitter=True)
+    _registry().histogram(
+        "deepgo_reshard_scatter_seconds",
+        "device re-scatter time of one reshard under the target mesh",
+    ).observe(time.monotonic() - t0)
+    return out
+
+
+def restore(params, opt_state, p_shardings, o_shardings) -> tuple:
+    """One full reshard: gather host values, re-scatter under the target
+    shardings, verify the live placement. Returns ``(params, opt_state,
+    findings)`` where ``findings`` are the sharding-claim mismatches
+    (empty in parity, or when the checker is off — the elastic recovery
+    loop arms it for the duration of every post-loss restore)."""
+    from ..analysis import xlacheck
+
+    params = scatter(gather_to_host(params), p_shardings)
+    opt_state = scatter(gather_to_host(opt_state), o_shardings)
+    findings = list(xlacheck.check_sharding(
+        "reshard.params", params, p_shardings))
+    findings += xlacheck.check_sharding(
+        "reshard.opt_state", opt_state, o_shardings)
+    _registry().counter(
+        "deepgo_reshard_restores_total",
+        "training states re-scattered under a (possibly different) mesh",
+    ).inc()
+    return params, opt_state, findings

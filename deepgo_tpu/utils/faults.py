@@ -49,6 +49,16 @@ programmatically / via ``ExperimentConfig.faults``) and consulted at named
                    fault kills the gatekeeper component; the service
                    re-queues the challenger so the restarted gatekeeper
                    re-gates it instead of dropping the window
+  reshard_gather   the gather-to-host half of a resharding restore
+                   (parallel/reshard.py) — transients are absorbed by
+                   the bounded full-jitter retry (flaky storage mid-
+                   recovery), hard faults surface typed
+  reshard_scatter  the device re-scatter half of a resharding restore —
+                   same bounded-retry contract as the gather
+  reshard_collective  the cross-host convergence barrier a reshard is
+                   part of — slow@MS emulates a collective timeout (the
+                   bounded retry + deadline watchdogs bound it), hard
+                   faults surface typed
 
 Grammar (comma-separated ``site:kind@arg`` specs):
 

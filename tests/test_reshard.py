@@ -1,0 +1,530 @@
+"""The resharding checkpoint layer (parallel/reshard.py) and the
+tp-crossing elastic recovery built on it (docs/robustness.md,
+"Reshard-on-remesh").
+
+Fast cases: save/restore round-trips across every dp×tp layout the 8
+virtual devices express, the mesh manifest's structural validation and
+corrupt-manifest refusal, the per_host_batch rebalance matrix, the
+shrink_tp policy, and the reshard fault sites. The slow case is the
+acceptance chaos test: two composed-mesh hosts, one SIGKILLed
+mid-training, the survivor resharding tp 2 -> 1 and landing bit-identical
+to an uninterrupted run performing the same planned remesh at the same
+step (a tp change alters the accumulation order of subsequent
+conv-backward reductions, so the never-killed reference must follow the
+same mesh schedule — the reshard itself adds zero divergence on top)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import REPO_ROOT
+from deepgo_tpu.analysis import xlacheck
+from deepgo_tpu.data.transcribe import transcribe_split
+from deepgo_tpu.experiments import Experiment, ExperimentConfig
+from deepgo_tpu.experiments import checkpoint as ckpt
+from deepgo_tpu.parallel import reshard
+from deepgo_tpu.parallel.distributed import per_host_batch
+from deepgo_tpu.parallel.elastic import shrink_tp
+from deepgo_tpu.parallel.liveness import ConfigError
+from deepgo_tpu.parallel.mesh import make_mesh
+from deepgo_tpu.utils import faults
+from deepgo_tpu.utils.metrics import read_jsonl
+
+N_DEVICES = 8
+
+# every (data, model) grid expressible on the 8 virtual devices
+ALL_LAYOUTS = [(dp, tp)
+               for dp in (1, 2, 4, 8)
+               for tp in (1, 2, 4, 8)
+               if dp * tp <= N_DEVICES]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def data_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("processed")
+    for split in ("validation", "test"):
+        transcribe_split(
+            os.path.join(REPO_ROOT, "data/sgf", split),
+            str(root / split),
+            workers=1,
+            verbose=False,
+        )
+    return str(root)
+
+
+def _cfg(run_dir, **kw):
+    # init() never touches the data root, so round-trip cases can use a
+    # placeholder; training cases override it with the real fixture
+    defaults = dict(
+        name="reshard-test", num_layers=2, channels=8, batch_size=8,
+        momentum=0.9, data_root="<unused>", loader_threads=0,
+        keep_checkpoints=0, run_dir=str(run_dir),
+    )
+    defaults.update(kw)
+    return ExperimentConfig(**defaults)
+
+
+def _salt(exp):
+    """Make every leaf position-distinct so a shard-order or permutation
+    bug cannot cancel out (fresh momentum is all-zeros otherwise)."""
+    def salt(leaf):
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        return leaf + jnp.arange(leaf.size, dtype=leaf.dtype
+                                 ).reshape(leaf.shape) / leaf.size
+    exp.params = jax.tree.map(salt, exp.params)
+    exp.opt_state = jax.tree.map(salt, exp.opt_state)
+
+
+def _host_leaves(tree):
+    return [np.asarray(l) for l in jax.tree.leaves(tree)]
+
+
+def _assert_trees_equal(a, b):
+    la, lb = _host_leaves(a), _host_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# round trips: save under A -> restore under B -> back under A, all layouts
+
+
+class TestRoundTrips:
+    @pytest.mark.parametrize("dp,tp", ALL_LAYOUTS)
+    def test_restore_under_every_layout_is_value_identical(
+            self, dp, tp, tmp_path):
+        """Mesh A = the canonical composed 2x2; B sweeps every layout the
+        device world expresses. Restoring A's checkpoint under B must
+        preserve every leaf bitwise, and restoring B's re-save back under
+        A must land on the original bytes."""
+        a = Experiment(_cfg(tmp_path / "a", data_parallel=2,
+                            tensor_parallel=2))
+        a.init()
+        _salt(a)
+        path_a = a.save()
+
+        b = Experiment.load(
+            path_a, remesh={"data_parallel": dp, "tensor_parallel": tp})
+        assert dict(b.mesh.shape) == {"data": dp, "model": tp}
+        _assert_trees_equal(a.params, b.params)
+        _assert_trees_equal(a.opt_state, b.opt_state)
+
+        # explicit path: both experiments are at step 0 and share a run
+        # dir, so a managed save here would overwrite A's checkpoint
+        path_b = b.save(str(tmp_path / "b.npz"))
+        manifest = ckpt.load_meta(path_b)["mesh"]
+        assert (manifest["data"], manifest["model"]) == (dp, tp)
+
+        back = Experiment.load(
+            path_b, remesh={"data_parallel": 2, "tensor_parallel": 2})
+        _assert_trees_equal(a.params, back.params)
+        _assert_trees_equal(a.opt_state, back.opt_state)
+
+    def test_restore_places_per_the_new_mesh_not_the_manifest(self, tmp_path):
+        """The manifest documents the writer's layout; the restore derives
+        placement from the TARGET mesh — tp=4 shards the 8-channel conv
+        weights 2-per-device even though the writer replicated them."""
+        a = Experiment(_cfg(tmp_path / "a", data_parallel=2,
+                            tensor_parallel=1))
+        a.init()
+        path = a.save()
+        b = Experiment.load(
+            path, remesh={"data_parallel": 2, "tensor_parallel": 4})
+        specs = {str(l.sharding.spec)
+                 for l in jax.tree.leaves(b.params["layers"])}
+        assert any("'model'" in s for s in specs), specs
+
+    def test_zero_sharding_composes_with_tp_placement(self, tmp_path):
+        """The composed contract: momentum leaves carry BOTH axes — tp
+        channel-sharding inherited from the placed params, ZeRO's "data"
+        merged on top (optimizer.init must run on placed params for this;
+        a host-side init would lose the "model" half). Needs a middle
+        layer: its (3, 3, C, C) momentum is the only leaf with both a
+        divisible free dim AND a tp-sharded one (edge convs have odd
+        input-plane/spatial dims, the head has one channel)."""
+        exp = Experiment(_cfg(tmp_path, num_layers=3,
+                              data_parallel=2, tensor_parallel=2))
+        exp.init()
+        specs = {str(l.sharding.spec)
+                 for l in jax.tree.leaves(exp.opt_state)}
+        composed = [s for s in specs if "'data'" in s and "'model'" in s]
+        assert composed, specs
+
+    def test_restore_findings_empty_with_checker_armed(self, tmp_path):
+        a = Experiment(_cfg(tmp_path / "a", data_parallel=2,
+                            tensor_parallel=2))
+        a.init()
+        path = a.save()
+        xlacheck.enable(True)
+        try:
+            b = Experiment.load(path, remesh={"tensor_parallel": 1,
+                                              "data_parallel": 4})
+        finally:
+            xlacheck.enable(None)
+        assert b.last_restore_findings == []
+
+
+# ---------------------------------------------------------------------------
+# the mesh manifest: structure, validation, corrupt refusal
+
+
+class TestManifest:
+    def test_saved_meta_carries_the_manifest(self, tmp_path):
+        exp = Experiment(_cfg(tmp_path, data_parallel=2, tensor_parallel=2))
+        exp.init()
+        meta = ckpt.load_meta(exp.save())
+        m = meta["mesh"]
+        assert m["version"] == reshard.MANIFEST_VERSION
+        assert (m["data"], m["model"], m["devices"]) == (2, 2, 4)
+        assert m["zero_opt"] is True
+        assert len(m["params"]) == len(jax.tree.leaves(exp.params))
+        assert len(m["opt_state"]) == len(jax.tree.leaves(exp.opt_state))
+        assert all(isinstance(s, str) for s in m["params"] + m["opt_state"])
+
+    @pytest.mark.parametrize("mangle,match", [
+        (lambda m: "nope", "not a dict"),
+        (lambda m: {**m, "data": 0}, "positive int"),
+        (lambda m: {**m, "model": True}, "positive int"),
+        (lambda m: {**m, "devices": 3}, "inconsistent"),
+        (lambda m: {**m, "params": "x"}, "partition-spec strings"),
+        (lambda m: {**m, "opt_state": [1, 2]}, "partition-spec strings"),
+        (lambda m: {**m, "params": m["params"][:-1]}, "spliced or corrupt"),
+    ])
+    def test_validate_manifest_refuses_structural_corruption(
+            self, mangle, match, tmp_path):
+        exp = Experiment(_cfg(tmp_path, data_parallel=2, tensor_parallel=2))
+        exp.init()
+        good = ckpt.load_meta(exp.save())["mesh"]
+        n_p = len(jax.tree.leaves(exp.params))
+        n_o = len(jax.tree.leaves(exp.opt_state))
+        with pytest.raises(ckpt.CheckpointError, match=match):
+            ckpt.validate_manifest(mangle(good), "<test>",
+                                   n_params=n_p, n_opt=n_o)
+
+    def _rewrite_meta(self, path, mutate):
+        """Rewrite the npz's meta member in place. The integrity block
+        covers ARRAY payloads only, so this models exactly the corruption
+        class the structural manifest validation exists for."""
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        meta = json.loads(bytes(arrays["meta"]).decode())
+        mutate(meta)
+        arrays["meta"] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)
+        np.savez(path, **arrays)
+
+    def test_corrupt_manifest_refused_and_skipped_by_find_latest_valid(
+            self, tmp_path):
+        exp = Experiment(_cfg(tmp_path, data_parallel=2, tensor_parallel=2))
+        exp.init()
+        old = exp.save()
+        exp.step = 10
+        newer = exp.save()
+
+        def mutate(meta):
+            meta["mesh"]["devices"] = 99  # 2 x 2 != 99
+
+        self._rewrite_meta(newer, mutate)
+        with pytest.raises(ckpt.CheckpointError, match="inconsistent"):
+            ckpt.verify_checkpoint(newer)
+        # array integrity alone would still pass — the refusal is the
+        # manifest's, and auto-resume falls back to the older good file
+        skipped = []
+        assert ckpt.find_latest_valid(exp.run_path,
+                                      log=skipped.append) == old
+        assert any("mesh manifest" in line for line in skipped)
+
+    def test_pre_manifest_checkpoints_still_load(self, tmp_path):
+        exp = Experiment(_cfg(tmp_path, data_parallel=2, tensor_parallel=1))
+        exp.init()
+        path = exp.save()
+        self._rewrite_meta(path, lambda meta: meta.pop("mesh"))
+        assert ckpt.verify_checkpoint(path)["step"] == 0
+        assert Experiment.load(path).step == 0
+
+
+# ---------------------------------------------------------------------------
+# per_host_batch rebalance after a tp-changing re-mesh
+
+
+class TestPerHostBatchMatrix:
+    @pytest.mark.parametrize("batch,width", [
+        (8, 3), (10, 4), (9, 2), (7, 2), (32, 5), (1, 2),
+    ])
+    def test_indivisible_batch_raises_typed_error_naming_both(
+            self, batch, width):
+        with pytest.raises(ConfigError) as e:
+            per_host_batch(batch, process_count=width)
+        msg = str(e.value)
+        assert str(batch) in msg and str(width) in msg
+
+    @pytest.mark.parametrize("batch,width,want", [
+        (8, 1, 8), (8, 2, 4), (8, 4, 2), (32, 4, 8), (8, 8, 1),
+    ])
+    def test_divisible_batch_rebalances(self, batch, width, want):
+        assert per_host_batch(batch, process_count=width) == want
+
+    def test_zero_width_rejected(self):
+        with pytest.raises(ConfigError, match=">= 1"):
+            per_host_batch(8, process_count=0)
+
+
+# ---------------------------------------------------------------------------
+# the shrink policy
+
+
+class TestShrinkTp:
+    @pytest.mark.parametrize("tp,alive,expected,want", [
+        (2, 1, 2, 1),   # the chaos case: half the fleet -> half the tp
+        (4, 2, 4, 2),
+        (4, 1, 4, 1),
+        (4, 3, 4, 2),   # 3 is not a divisor of 4 -> round down to 2
+        (4, 1, 2, 2),
+        (2, 3, 4, 1),
+        (1, 1, 8, 1),   # never below 1
+        (2, 2, 2, 2),   # nothing lost -> nothing shrunk
+        (2, 5, 2, 2),   # defensive: more alive than expected
+    ])
+    def test_policy(self, tp, alive, expected, want):
+        got = shrink_tp(tp, alive, expected)
+        assert got == want
+        assert tp % got == 0
+
+
+# ---------------------------------------------------------------------------
+# fault sites: reshard_gather / reshard_scatter / reshard_collective
+
+
+class TestFaultSites:
+    def _tree(self):
+        mesh = make_mesh(2, 1)
+        rep = jax.device_put(jnp.arange(8.0),
+                             jax.sharding.NamedSharding(
+                                 mesh, jax.sharding.PartitionSpec()))
+        return {"w": rep}, jax.tree.map(lambda l: l.sharding, {"w": rep})
+
+    def test_transient_gather_absorbed_by_bounded_retry(self):
+        tree, _ = self._tree()
+        faults.install("reshard_gather:transient@2")
+        out = reshard.gather_to_host(tree)
+        np.testing.assert_array_equal(out["w"], np.arange(8.0))
+
+    def test_hard_gather_fault_surfaces_typed(self):
+        tree, _ = self._tree()
+        faults.install("reshard_gather:fail@1")
+        with pytest.raises(faults.InjectedFailure):
+            reshard.gather_to_host(tree)
+
+    def test_transient_scatter_absorbed_hard_surfaces(self):
+        tree, sh = self._tree()
+        host = reshard.gather_to_host(tree)
+        faults.install("reshard_scatter:transient@2")
+        out = reshard.scatter(host, sh)
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(8.0))
+        faults.install("reshard_scatter:fail@1")
+        with pytest.raises(faults.InjectedFailure):
+            reshard.scatter(host, sh)
+
+    def test_collective_timeout_emulated_by_slow_site(self):
+        """slow@MS on the barrier site brownouts the scatter without
+        killing it — the gray collective timeout; the restore completes."""
+        tree, sh = self._tree()
+        host = reshard.gather_to_host(tree)
+        faults.install("reshard_collective:slow@80")
+        t0 = time.monotonic()
+        out = reshard.scatter(host, sh)
+        assert time.monotonic() - t0 >= 0.08
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(8.0))
+
+    def test_hard_collective_fault_surfaces(self):
+        tree, sh = self._tree()
+        host = reshard.gather_to_host(tree)
+        faults.install("reshard_collective:fail@1")
+        with pytest.raises(faults.InjectedFailure):
+            reshard.scatter(host, sh)
+
+
+# ---------------------------------------------------------------------------
+# the bench gate fold: steps-lost next to the gated recovery latency
+
+
+class TestStepsLostGateFold:
+    def _apply(self, result, entry, tmp_path, monkeypatch):
+        import bench
+
+        class Args:
+            gate = 0.10
+
+        path = tmp_path / "last_good.json"
+        if entry is not None:
+            path.write_text(json.dumps({result["metric"]: entry}))
+        monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(path))
+        bench._apply_gate(result, Args())
+        return result
+
+    def _result(self, **kw):
+        out = {"metric": "distributed_elastic_recovery_latency_s",
+               "value": 4.0, "device": "cpu"}
+        out.update(kw)
+        return out
+
+    def test_skip_without_baseline_steps_lost(self, tmp_path, monkeypatch):
+        entry = {"value": 4.0, "device": "cpu"}  # pre-chaos-leg record
+        result = self._apply(self._result(steps_lost=13), entry,
+                             tmp_path, monkeypatch)
+        fold = result["gate"]["steps_lost"]
+        assert fold["verdict"] == "skip"
+        assert "no steps_lost" in fold["reason"]
+        assert result["gate"]["verdict"] != "fail"
+
+    def test_within_one_checkpoint_window_passes(self, tmp_path, monkeypatch):
+        import bench
+
+        entry = {"value": 4.0, "device": "cpu", "steps_lost": 13}
+        result = self._apply(
+            self._result(steps_lost=13 + bench.DIST_CKPT_INTERVAL),
+            entry, tmp_path, monkeypatch)
+        assert result["gate"]["steps_lost"]["verdict"] == "pass"
+
+    def test_regressed_steps_lost_fails_the_gate(self, tmp_path, monkeypatch):
+        import bench
+
+        entry = {"value": 4.0, "device": "cpu", "steps_lost": 13}
+        result = self._apply(
+            self._result(steps_lost=14 + bench.DIST_CKPT_INTERVAL),
+            entry, tmp_path, monkeypatch)
+        assert result["gate"]["steps_lost"]["verdict"] == "fail"
+        assert result["gate"]["verdict"] == "fail"
+        assert "rolls back further" in result["gate"]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the tp-crossing SIGKILL chaos recovery
+
+
+def run_host(rundir, data_root, *, host, hosts, iters, faults_env=None,
+             budget=(0.5, 8)):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DEEPGO_FAULTS", None)
+    if faults_env:
+        env["DEEPGO_FAULTS"] = faults_env
+    sets = [
+        "name=reshard-chaos", "num_layers=2", "channels=8", "batch_size=8",
+        "rate=0.05", "validation_size=16", "validation_interval=20",
+        "print_interval=5", f"data_root={data_root}",
+        "train_split=validation", "validation_split=test",
+        "loader_threads=0", "data_parallel=2", "tensor_parallel=2",
+        "keep_checkpoints=0",
+    ]
+    interval, miss = budget
+    cmd = [sys.executable, "-m", "deepgo_tpu.cli", "train",
+           "--iters", str(iters), "--elastic", "--reshard",
+           "--auto-resume", rundir,
+           "--process-id", str(host), "--expected-hosts", str(hosts),
+           "--heartbeat-interval", str(interval), "--miss-budget", str(miss),
+           "--init-deadline", "120", "--step-deadline", "300",
+           "--set", *sets]
+    return subprocess.Popen(cmd, cwd=REPO_ROOT, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+
+@pytest.mark.slow
+def test_tp_crossing_sigkill_chaos_recovers_bit_exact(data_root, tmp_path):
+    """Acceptance (ISSUE 18): two composed-mesh (dp=2 x tp=2 x ZeRO) hosts
+    over one shared run dir; the victim is SIGKILLed after its step-20
+    checkpoint. The survivor must shrink tp 2 -> 1 (`--reshard`), reshard
+    the converged checkpoint into the new layout with ZERO sharding-claim
+    findings, resume, and land bit-identical to an uninterrupted run that
+    performs the same planned remesh at the same step."""
+    shared = str(tmp_path / "fleet")
+    # the miss budget (0.5s x 20 = 10s) must clear the composed-mesh
+    # first-step compile (~5s on CPU): heartbeats ride the print-window
+    # cadence, so a budget under the compile gap false-positives on a
+    # live peer. iters then gives the survivor enough post-kill runway
+    # (~26 steps/s) to still be mid-run when the real loss is declared.
+    iters, budget = 600, (0.5, 20)
+
+    procs = [
+        run_host(shared, data_root, host=0, hosts=2, iters=iters,
+                 budget=budget),
+        # killed at step 30 — after the step-20 checkpoint exists
+        run_host(shared, data_root, host=1, hosts=2, iters=iters,
+                 faults_env="kill:step@30", budget=budget),
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    (rc0, out0, err0), (rc1, out1, err1) = outs
+    assert rc1 == -9, (rc1, err1[-800:])
+    assert rc0 == 0, (rc0, err0[-2000:])
+
+    recs = [json.loads(l.split(" ", 1)[1]) for l in out0.splitlines()
+            if l.startswith("ELASTIC_RECOVERY ")]
+    done = [json.loads(l.split(" ", 1)[1]) for l in out0.splitlines()
+            if l.startswith("ELASTIC_DONE ")]
+    assert done and done[-1]["final_step"] == iters
+    assert recs, "survivor never reported a recovery"
+    rec = recs[0]
+    assert rec["process_id"] == 1
+    assert rec["tp_from"] == 2 and rec["tp_to"] == 1
+    assert rec["tp"] == 1
+    assert rec["sharding_findings"] == 0
+    assert rec["survivors"] == [0]
+    assert rec["per_host_batch"] == 8  # re-derived over the lone survivor
+    resumed = rec["resumed_step"]
+    assert resumed >= 20, rec  # the step-20 checkpoint existed pre-kill
+
+    # the remesh decision and restore are in the durable event stream
+    kinds = [r["kind"] for r in
+             read_jsonl(os.path.join(shared, "elastic-0000.jsonl"))]
+    assert "elastic_remesh" in kinds and "reshard_restore" in kinds
+
+    # reference: uninterrupted, same planned mesh schedule — tp=2 to the
+    # converged step, reshard to tp=1 (dp fixed), continue to the target
+    ref_cfg = _cfg(tmp_path / "ref", data_parallel=2, tensor_parallel=2,
+                   name="reshard-chaos", rate=0.05, validation_size=16,
+                   validation_interval=20, print_interval=5,
+                   data_root=data_root, train_split="validation",
+                   validation_split="test", momentum=0.0, elastic=True)
+    ref = Experiment(ref_cfg)
+    ref.run(resumed)
+    ref_path = ref.save()  # state at exactly the survivor's converge step
+    ref2 = Experiment.load(ref_path, remesh={"tensor_parallel": 1})
+    assert ref2.last_restore_findings == []
+    ref2.run(iters - resumed)
+    assert ref2.step == iters
+
+    meta_s, p_s, o_s = ckpt.load_checkpoint(
+        os.path.join(shared, ckpt.checkpoint_name(iters)))
+    for a, b in zip(p_s, _host_leaves(ref2.params)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(o_s, _host_leaves(ref2.opt_state)):
+        np.testing.assert_array_equal(a, b)
+    assert meta_s["ewma"] == ref2.ewma
+    assert meta_s["config"]["tensor_parallel"] == 1  # the remesh stuck
