@@ -5,7 +5,7 @@
     verify-obs \
     verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
     verify-cost verify-quant verify-telemetry verify-workload \
-    verify-chaos verify-cache bench bench-gate smoke clean
+    verify-chaos verify-cache verify-sessions bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -85,7 +85,10 @@ verify-chaos:  # chaos campaigns: fault-kind/scenario/hedging/ejection/canary su
 verify-cache:  # position cache: shared digest/augment table pinning, canonical-hit bitwise remap (all 8 views), coalescing + leader-failure promotion, reload invalidation zero-stale, surge-tier routing, cli --simulate-cache
 	JAX_PLATFORMS=cpu python -m pytest tests/test_cache.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-remesh verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload verify-chaos verify-cache  # the full failure-model suite
+verify-sessions:  # durable game sessions: superko/suicide/pass-pass legality pinned to replay ground truth, WAL acked==durable + torn-tail + checkpoint fallback, deadline-tiered replies, resumable bulk scan, per-session workload label
+	JAX_PLATFORMS=cpu python -m pytest tests/test_sessions.py -q
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-remesh verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload verify-chaos verify-cache verify-sessions  # the full failure-model suite
 
 bench:
 	python bench.py

@@ -130,6 +130,7 @@ _METRIC_OF = {
     "distributed": ("distributed_elastic_recovery_latency_s", "s"),
     "loop": ("loop_games_per_hour", "games/hour"),
     "chaos": ("chaos_brownout_interactive_good_frac", "frac within SLO"),
+    "mixed": ("mixed_session_interactive_good_frac", "frac within SLO"),
 }
 
 
@@ -2220,13 +2221,311 @@ def _bench_chaos(on_tpu: bool, trace_capture: str | None = None,
     return result
 
 
+def _parse_child_protocol(output: str) -> dict:
+    """The sessions/child.py line protocol -> {acks, digests, resumed}."""
+    acks: list = []
+    digests: dict = {}
+    resumed = None
+    for line in output.splitlines():
+        parts = line.split()
+        if not parts:
+            continue
+        if parts[0] == "SESSION_ACK" and len(parts) == 3:
+            acks.append((parts[1], int(parts[2])))
+        elif parts[0] == "SESSION_DIGEST" and len(parts) == 3:
+            digests[parts[1]] = parts[2]
+        elif parts[0] == "SESSION_RESUMED" and len(parts) == 2:
+            resumed = int(parts[1])
+    return {"acks": acks, "digests": digests, "resumed": resumed}
+
+
+def _bench_mixed(on_tpu: bool) -> dict:
+    """The durable-sessions mixed-workload chaos gate (ISSUE 19,
+    deepgo_tpu/sessions, docs/robustness.md "Session failure domains").
+
+    Two legs, one verdict:
+
+      coexistence   one heterogeneous (tpu, cpu) fleet serves live
+                    interactive games (WAL-acked client moves + engine
+                    replies on the interactive tier) WHILE a bulk SGF
+                    scan saturates the batch tier, with transient
+                    session_wal / session_reply fault windows opened
+                    mid-run by the scenario scheduler. Graded on: the
+                    interactive latency SLO holds (within-threshold
+                    fraction over exactly this leg's requests), both
+                    fault sites actually fired and were absorbed (zero
+                    failed acks / replies), the scan annotated
+                    positions AND shed under pressure, the cpu surge
+                    replica served traffic, and the workload capture
+                    distinguishes the session-shaped traffic
+      crash_resume  a scripted session server (sessions/child.py) is
+                    SIGKILLed mid-game after K fsync-acked moves; the
+                    parent verifies every acked move is durable in the
+                    store a fresh process recovers, then a resumed
+                    child must finish every game BIT-IDENTICALLY
+                    (digest equality) to a never-killed reference run
+
+    The headline value is the coexistence leg's interactive
+    within-SLO fraction; `chaos_gate` carries the verdict (enforced
+    unconditionally by ``_exit_gate``, with or without --gate)."""
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax
+
+    from deepgo_tpu.chaos import (FaultEvent, Scenario, ScenarioScheduler,
+                                  defended_config)
+    from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.obs import workload as workload_mod
+    from deepgo_tpu.obs.slo import HistogramLatencyObjective
+    from deepgo_tpu.serving import (EngineConfig, FleetConfig,
+                                    SupervisorConfig, fleet_policy_engine)
+    from deepgo_tpu.sessions import (GameService, SessionStore,
+                                     SgfAnalysisService)
+
+    reasons: list = []
+    sgf_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "data", "sgf")
+    work = tempfile.mkdtemp(prefix="bench-mixed-")
+    slo_threshold_s, slo_target = 0.15, 0.95
+
+    # ---- leg 1: interactive sessions vs saturating bulk analysis -------
+    cfg = policy_cnn.CONFIGS["small"]
+    params = policy_cnn.init(jax.random.key(0), cfg)
+    buckets = (1, 8, 32, 128) if on_tpu else (1, 8, 32)
+    fleet = fleet_policy_engine(
+        params, cfg, replicas=2,
+        config=EngineConfig(buckets=buckets, max_wait_ms=2.0),
+        fleet=defended_config(FleetConfig(respawn_base_s=0.01,
+                                          respawn_cap_s=0.05)),
+        supervisor=SupervisorConfig(max_restarts=0, backoff_base_s=0.01,
+                                    backoff_cap_s=0.05),
+        name="mixed", platforms=("tpu", "cpu"))
+    fleet.warmup()
+    workload_mod.configure_workload(
+        capture_dir=os.path.join(work, "capture"), store_positions=False)
+    store = SessionStore(os.path.join(work, "sessions"),
+                         checkpoint_every=8)
+    service = GameService(fleet, store)
+    # a tight batch deadline: the admission door (batch headroom 0.3)
+    # sheds the scan's burst tail instead of letting it queue ahead of
+    # interactive traffic — exactly the coexistence contract under test
+    analysis = SgfAnalysisService(fleet, os.path.join(work, "analysis"),
+                                  timeout_s=0.05, attempts=1,
+                                  blunder_top=30)
+    # the chaos timeline: brown out the WAL ack barrier, then the
+    # engine-reply path, while both workloads are in flight
+    scenario = Scenario(name="mixed-session", seed=23, events=(
+        FaultEvent(at_s=0.5, kind="wal", arg=2),
+        FaultEvent(at_s=1.0, kind="reply", arg=2),))
+    scheduler = ScenarioScheduler(scenario, fleet_name="mixed")
+    objective = HistogramLatencyObjective(
+        "mixed-interactive", "deepgo_serving_request_seconds",
+        slo_threshold_s, target=slo_target, engine="mixed",
+        tier="interactive")
+    good0, total0 = objective.sample()
+    analysis_report: dict = {}
+
+    def run_analysis() -> None:
+        analysis_report.update(
+            analysis.run(sgf_dir, limit_positions=900))
+
+    analysis_thread = threading.Thread(target=run_analysis,
+                                       name="mixed-analysis", daemon=True)
+    sessions = [service.new_game(f"live-{i}") for i in range(3)]
+    scripts = {sid: _mixed_script(i) for i, sid in enumerate(sessions)}
+    interactive_errors = 0
+    scheduler.start()
+    analysis_thread.start()
+    try:
+        for _round in range(12):
+            for sid in sessions:
+                game = store.get(sid)
+                if game.over:
+                    continue
+                point = next((p for p in scripts[sid]
+                              if game.check_move(*p, game.to_play)
+                              is None), None)
+                try:
+                    if point is None:
+                        service.play(sid, None, None, reply=True)
+                    else:
+                        service.play(sid, point[0], point[1], reply=True)
+                except Exception:  # noqa: BLE001 — graded, not fatal
+                    interactive_errors += 1
+                time.sleep(0.04)
+        analysis_thread.join(timeout=120.0)
+    finally:
+        scheduler.stop()
+        workload_mod.disable_workload()
+    good1, total1 = objective.sample()
+    total = total1 - total0
+    good_frac = round((good1 - good0) / total, 4) if total else 0.0
+    sstats = service.stats()
+    cap = workload_mod.load_capture(os.path.join(work, "capture"))
+    sessions_block = workload_mod.characterize(
+        cap["requests"]).get("sessions") or {}
+    cpu_boards = sum(
+        s.get("boards") or 0 for s in fleet.stats()["replicas"]
+        if s.get("platform") == "cpu")
+    analysis.close()
+    service.close()
+    fleet.close()
+
+    if total == 0:
+        reasons.append("coexistence: no interactive-tier requests "
+                       "reached the latency histogram")
+    elif good_frac < slo_target:
+        reasons.append(f"coexistence: interactive SLO missed — "
+                       f"{good_frac:.2%} within {slo_threshold_s}s "
+                       f"(target {slo_target:.0%}) while batch "
+                       "saturated")
+    if interactive_errors:
+        reasons.append(f"coexistence: {interactive_errors} interactive "
+                       "move(s) failed outright under transient chaos")
+    if not sstats["wal_retries"]:
+        reasons.append("coexistence: the session_wal fault window never "
+                       "fired — the ack barrier's retry path went "
+                       "untested")
+    if not sstats["reply_retries"]:
+        reasons.append("coexistence: the session_reply fault window "
+                       "never fired — deadline-tier escalation went "
+                       "untested")
+    if sstats["corrupt_sessions"]:
+        reasons.append(f"coexistence: {len(sstats['corrupt_sessions'])} "
+                       "session(s) corrupt after transient-only chaos")
+    if not analysis_report.get("annotated"):
+        reasons.append("coexistence: the bulk scan annotated nothing")
+    if not analysis_report.get("outcomes", {}).get("shed"):
+        reasons.append("coexistence: the batch tier never shed — the "
+                       "scan did not actually saturate")
+    if not cpu_boards:
+        reasons.append("coexistence: the cpu surge replica served "
+                       "nothing")
+    if sessions_block.get("count", 0) < 3:
+        reasons.append("coexistence: the workload capture saw "
+                       f"{sessions_block.get('count', 0)} session "
+                       "label(s) — session-shaped traffic is not "
+                       "distinguishable")
+
+    # ---- leg 2: SIGKILL mid-game, zero lost acks, bit-identical resume -
+    child = [sys.executable, "-m", "deepgo_tpu.sessions.child",
+             "--games", "2", "--moves", "6"]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    kill_after = 9
+
+    def run_child(store_dir: str, *extra: str) -> tuple:
+        proc = subprocess.run(
+            [*child, "--store", store_dir, *extra],
+            capture_output=True, text=True, timeout=240.0, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return proc, _parse_child_protocol(proc.stdout)
+
+    ref_dir = os.path.join(work, "ref")
+    vic_dir = os.path.join(work, "victim")
+    ref_proc, ref = run_child(ref_dir)
+    vic_proc, vic = run_child(vic_dir, "--kill-after-acks",
+                              str(kill_after))
+    if ref_proc.returncode != 0:
+        reasons.append("crash_resume: reference child failed rc="
+                       f"{ref_proc.returncode}: "
+                       f"{ref_proc.stderr.strip()[-200:]}")
+    if vic_proc.returncode != -9:
+        reasons.append("crash_resume: victim was not SIGKILLed "
+                       f"(rc={vic_proc.returncode})")
+    if len(vic["acks"]) != kill_after:
+        reasons.append(f"crash_resume: victim printed "
+                       f"{len(vic['acks'])} ack(s), expected "
+                       f"{kill_after}")
+    # zero lost acked moves: a FRESH recovery of the victim's store
+    # must already hold every sequence number the victim acked
+    durable = SessionStore(vic_dir)
+    max_acked = max((seq for _, seq in vic["acks"]), default=0)
+    lost_acked = max(0, max_acked - durable.seq)
+    if lost_acked:
+        reasons.append(f"crash_resume: {lost_acked} acked move(s) "
+                       f"missing after recovery (durable seq "
+                       f"{durable.seq} < acked {max_acked})")
+    if durable.recovery["corrupt"]:
+        reasons.append("crash_resume: recovery marked "
+                       f"{durable.recovery['corrupt']} corrupt")
+    res_proc, res = run_child(vic_dir)
+    if res_proc.returncode != 0:
+        reasons.append("crash_resume: resumed child failed rc="
+                       f"{res_proc.returncode}: "
+                       f"{res_proc.stderr.strip()[-200:]}")
+    if not res["resumed"]:
+        reasons.append("crash_resume: the resumed child recovered no "
+                       "live session from the WAL")
+    if res["digests"] != ref["digests"] or not ref["digests"]:
+        reasons.append("crash_resume: resumed games are NOT "
+                       "bit-identical to the uninterrupted reference "
+                       f"({res['digests']} != {ref['digests']})")
+
+    metric, unit = _METRIC_OF["mixed"]
+    result = {
+        "bench": "mixed", "metric": metric, "unit": unit,
+        "value": good_frac,
+        "interactive": {
+            "sessions": len(sessions),
+            "requests": total,
+            "good_frac": good_frac,
+            "slo": {"threshold_s": slo_threshold_s,
+                    "target": slo_target},
+            "moves_acked": sstats["seq"],
+            "wal_retries": sstats["wal_retries"],
+            "reply_retries": sstats["reply_retries"],
+            "errors": interactive_errors,
+        },
+        "analysis": {k: analysis_report.get(k)
+                     for k in ("positions", "annotated", "blunders",
+                               "outcomes", "files_done",
+                               "stopped_early")},
+        "surge_cpu_boards": cpu_boards,
+        "sessions_workload": sessions_block,
+        "crash_resume": {
+            "kill_after_acks": kill_after,
+            "victim_rc": vic_proc.returncode,
+            "victim_acks": len(vic["acks"]),
+            "durable_seq": durable.seq,
+            "max_acked_seq": max_acked,
+            "lost_acked": lost_acked,
+            "resumed_sessions": res["resumed"],
+            "reference_digests": ref["digests"],
+            "resumed_digests": res["digests"],
+            "bit_identical": res["digests"] == ref["digests"]
+            and bool(ref["digests"]),
+        },
+        "scenario": scenario.to_dict(),
+        "chaos_gate": {"pass": not reasons, "reasons": reasons},
+    }
+    if reasons:
+        result["error"] = "; ".join(reasons[:3])
+    shutil.rmtree(work, ignore_errors=True)
+    return result
+
+
+def _mixed_script(i: int) -> list:
+    """A deterministic per-session move preference order (the same
+    seeded-shuffle idiom as sessions/child.py, offset so the bench's
+    live sessions never collide with the crash-leg's)."""
+    import random
+
+    points = [(x, y) for x in range(19) for y in range(19)]
+    random.Random(500 + i).shuffle(points)
+    return points
+
+
 def main() -> None:
     import argparse
 
     ap = argparse.ArgumentParser(description="deepgo_tpu benchmarks")
     ap.add_argument("--mode", default="inference",
                     choices=["inference", "train", "latency", "large",
-                             "serving", "distributed", "loop", "chaos"])
+                             "serving", "distributed", "loop", "chaos",
+                             "mixed"])
     ap.add_argument("--faults", nargs="?", const="__default__",
                     default=None, metavar="SPEC",
                     help="(--mode serving / distributed / loop) chaos run: "
@@ -2368,6 +2667,8 @@ def main() -> None:
         elif args.mode == "chaos":
             result = _bench_chaos(on_tpu, trace_capture=args.trace,
                                   replay_speed=args.replay_speed)
+        elif args.mode == "mixed":
+            result = _bench_mixed(on_tpu)
         elif args.mode == "loop":
             result = _bench_loop(on_tpu, args.faults)
         else:

@@ -67,12 +67,14 @@ class LintConfig:
     # the one legal sleep).
     sleep_scope: tuple = (
         "deepgo_tpu/serving/",
+        "deepgo_tpu/sessions/",
     )
 
     # typed-error: service layers raise typed errors that survive
     # `python -O`; asserts there are findings
     assert_scope: tuple = (
         "deepgo_tpu/serving/",
+        "deepgo_tpu/sessions/",
         "deepgo_tpu/loop/",
         "deepgo_tpu/obs/",
         "deepgo_tpu/parallel/",
@@ -123,11 +125,13 @@ class LintConfig:
     # observatory capture streams: request/position/capture-summary
     # records) in ISSUE 15; cache_* (the position cache's invalidation
     # event) in ISSUE 17; reshard_* (the resharding restore's event
-    # stream next to the deepgo_reshard_* metrics) in ISSUE 18.
+    # stream next to the deepgo_reshard_* metrics) in ISSUE 18;
+    # session_* (the durable game-session WAL records and the bulk-scan
+    # annotation stream) in ISSUE 19.
     grammar_prefixes: tuple = ("deepgo_", "obs_", "loop_", "fleet_",
                                "trace_", "lineage_", "cost_", "ts_",
                                "anomaly_", "workload_", "cache_",
-                               "reshard_")
+                               "reshard_", "session_")
     # doc tokens that share a grammar prefix but are not metrics/events:
     # bench JSON keys and similar
     grammar_ignore: frozenset = frozenset({

@@ -24,6 +24,13 @@ at the scheduled moments:
             weight reload mid-trace (the position cache's invalidation
             path). Spawned on its own thread: a reload blocks on the
             per-replica drain, and the timeline must keep walking
+  wal       open ``session_wal:transient@N`` — the next N session-store
+            WAL appends fail transiently (the ack barrier's retry
+            path); closed early when ``duration_s`` > 0. Process-wide:
+            the session store is not a replica
+  reply     open ``session_reply:transient@N`` — the next N engine-reply
+            submits fail transiently (the deadline-tier escalation
+            path); closed early when ``duration_s`` > 0
 
 Events target replicas by index; the scheduler maps an index to the
 engine name (``<fleet>-<idx>`` by convention, overridable) because the
@@ -42,7 +49,12 @@ from ..analysis.lockcheck import make_lock
 from ..obs.spans import span
 from ..utils import faults
 
-EVENT_KINDS = ("kill", "slow", "corrupt", "saturate", "reload")
+EVENT_KINDS = ("kill", "slow", "corrupt", "saturate", "reload",
+               "wal", "reply")
+
+# wal/reply target the session layer's process-wide fault sites, not a
+# replica-indexed engine site
+_SESSION_SITE_OF = {"wal": "session_wal", "reply": "session_reply"}
 
 
 @dataclass(frozen=True)
@@ -71,7 +83,8 @@ class FaultEvent:
             raise ValueError("slow events need duration_s > 0: an "
                              "unbounded brownout is a config bug, not "
                              "a scenario")
-        if self.kind in ("slow", "corrupt", "saturate") and self.arg < 1:
+        if (self.kind in ("slow", "corrupt", "saturate", "wal", "reply")
+                and self.arg < 1):
             raise ValueError(
                 f"{self.kind} events need arg >= 1, got {self.arg}")
 
@@ -169,6 +182,15 @@ class ScenarioScheduler:
                     acts.append((ev.at_s + ev.duration_s, ev, "close",
                                  lambda s=site:
                                  self._close(s, "corrupt")))
+            elif ev.kind in _SESSION_SITE_OF:
+                site = _SESSION_SITE_OF[ev.kind]
+                acts.append((ev.at_s, ev, "open",
+                             lambda s=site, a=ev.arg:
+                             self._open(s, "transient", a)))
+                if ev.duration_s > 0:
+                    acts.append((ev.at_s + ev.duration_s, ev, "close",
+                                 lambda s=site:
+                                 self._close(s, "transient")))
             elif ev.kind == "saturate":
                 acts.append((ev.at_s, ev, "open",
                              lambda n=ev.arg: self._saturate(n)))

@@ -268,6 +268,25 @@ def cmd_serve(args) -> None:
               "checkpoint)", flush=True)
     exporter = start_exporter(args.obs_port)
     exporter.add_health("fleet", health_from_engine(fleet))
+    session_service = None
+    if args.sessions:
+        # the durable game-session service rides the same daemon: the
+        # store auto-recovers (checkpoint + WAL replay) in its
+        # constructor, so a restarted daemon resumes every live game
+        # before the first request lands; its liveness (open sessions,
+        # WAL lag, corrupt count) joins the composed /healthz verdict
+        from .sessions import GameService, SessionStore
+
+        store = SessionStore(args.sessions)
+        session_service = GameService(fleet, store)
+        exporter.add_health("sessions", session_service.health)
+        rec = store.recovery
+        print(f"serve: session store {args.sessions} — "
+              f"{rec['sessions']} live game(s) resumed "
+              f"(checkpoint seq {rec['checkpoint_seq']}, "
+              f"{rec['wal_records_applied']} WAL record(s) replayed"
+              + (f", {len(rec['corrupt'])} corrupt" if rec["corrupt"]
+                 else "") + ")", flush=True)
     sampler = telem_sink = None
     if args.telemetry_dir:
         # the fleet telemetry plane on the daemon (docs/observability.md
@@ -318,6 +337,10 @@ def cmd_serve(args) -> None:
                               "futures, zero recompiles)", flush=True)
     finally:
         health = fleet.health()
+        if session_service is not None:
+            # final compacting checkpoint: the next start resumes from
+            # one file instead of replaying the whole WAL tail
+            session_service.close()
         if sampler is not None:
             sampler.stop(final_sample=True)
             sampler.store.close()
@@ -991,6 +1014,13 @@ def main(argv=None) -> None:
     p.add_argument("--duration", type=float, default=0.0, metavar="S",
                    help="serve for S seconds then exit (0 = until "
                         "SIGINT/SIGTERM)")
+    p.add_argument("--sessions", metavar="DIR",
+                   help="host the durable game-session service over DIR "
+                        "(WAL + checkpoints; crashed/killed daemons "
+                        "resume every live game on restart) next to "
+                        "/metrics + /healthz — session liveness (open "
+                        "sessions, WAL lag) joins the composed health "
+                        "verdict (docs/robustness.md)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("loop", help="always-on expert-iteration service: "

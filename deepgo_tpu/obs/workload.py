@@ -448,6 +448,33 @@ def _zipf_fit(counts: list[int]) -> float | None:
     return round(-slope, 4)
 
 
+def _characterize_sessions(by_session: dict[str, list[float]],
+                           top_n: int = 8) -> dict:
+    """The per-session view of a labeled capture: how many distinct
+    sessions, how much of the traffic carries a label, and — for the
+    busiest ``top_n`` — each session's own arrival burstiness (Goh &
+    Barabasi, same convention as the global interarrival block), which
+    is what distinguishes session-shaped traffic (think-time gaps) from
+    scan-shaped saturation."""
+    top = {}
+    busiest = sorted(by_session.items(),
+                     key=lambda kv: (-len(kv[1]), kv[0]))[:top_n]
+    for sid, times in busiest:
+        entry: dict = {"requests": len(times)}
+        inter = np.diff(np.array(sorted(times)))
+        if inter.size >= 2:
+            mean = float(inter.mean())
+            cv = float(inter.std()) / mean if mean > 0 else None
+            entry["burstiness"] = (round((cv - 1) / (cv + 1), 4)
+                                   if cv is not None else None)
+        top[sid] = entry
+    return {
+        "count": len(by_session),
+        "labeled_requests": sum(len(t) for t in by_session.values()),
+        "top": top,
+    }
+
+
 def characterize(requests: list[dict]) -> dict:
     """The analyzer core over already-loaded request records (the
     capture-file-free entry bench and tests use)."""
@@ -459,12 +486,16 @@ def characterize(requests: list[dict]) -> dict:
     by_tier: dict[str, int] = {}
     by_bucket: dict[str, int] = {}
     by_outcome: dict[str, int] = {}
+    by_session: dict[str, list[float]] = {}
     latencies: list[float] = []
     for r in requests:
         d = r.get("digest")
         c = r.get("canonical", d)
         exact[d] = exact.get(d, 0) + 1
         canon[c] = canon.get(c, 0) + 1
+        if r.get("session") is not None:
+            by_session.setdefault(str(r["session"]), []).append(
+                float(r.get("t", 0.0)))
         tier = str(r.get("tier") or "untiered")
         by_tier[tier] = by_tier.get(tier, 0) + 1
         if r.get("bucket") is not None:
@@ -518,6 +549,8 @@ def characterize(requests: list[dict]) -> dict:
     if by_bucket:
         out["buckets"] = {b: by_bucket[b]
                           for b in sorted(by_bucket, key=int)}
+    if by_session:
+        out["sessions"] = _characterize_sessions(by_session)
     if interarrival is not None:
         out["interarrival"] = interarrival
     if latencies:
@@ -572,6 +605,17 @@ def format_workload(stats: dict) -> str:
             f"{stats.get('span_s')}s  interarrival mean "
             f"{inter['mean_ms']}ms p99 {inter['p99_ms']}ms  "
             f"cv {inter['cv']}  burstiness {inter['burstiness']}")
+    sess = stats.get("sessions")
+    if sess:
+        parts = []
+        for sid, entry in sess["top"].items():
+            b = entry.get("burstiness")
+            parts.append(f"{sid}={entry['requests']}"
+                         + (f" (B {b})" if b is not None else ""))
+        lines.append(
+            f"sessions: {sess['count']} distinct  "
+            f"{sess['labeled_requests']} labeled requests  "
+            + "  ".join(parts))
     for name in ("tiers", "buckets", "outcomes"):
         mix = stats.get(name)
         if mix:

@@ -131,6 +131,11 @@ class InferenceEngine:
         # supervisor's admission control estimates queue wait from their
         # p50 (a small window keeps the estimate current under load shifts)
         self._dispatch_secs: deque[float] = deque(maxlen=64)
+        # full (max-bucket) windows tracked separately: queue drain under
+        # backlog runs in max-bucket windows, and the all-sizes p50
+        # underestimates their cost badly when small interactive
+        # dispatches dominate the recent mix
+        self._window_secs: deque[float] = deque(maxlen=32)
         # solo lane: isolation retries from the resilience layer dispatch
         # strictly alone (never coalesced), so a retried request's failure
         # is attributable to IT. Internal — bypasses the bounded queue;
@@ -180,7 +185,15 @@ class InferenceEngine:
 
     def warmup(self) -> int:
         """Compile every ladder rung up front (empty-board batches), so the
-        steady state performs zero compilations. Returns rung count."""
+        steady state performs zero compilations. Returns rung count.
+
+        Each rung's second (post-compile) forward is timed and seeded
+        into the rolling dispatch-latency window, so admission control
+        has a latency prior before the first live dispatch. Without the
+        seed the estimate stays None under a tight-deadline flood —
+        queued requests expire before any dispatch succeeds, so the
+        congestion signal depends on exactly the work congestion
+        prevents, and the door never sheds."""
         for b in self.ladder.buckets:
             packed = np.zeros((b, 9, 19, 19), dtype=np.uint8)
             player = np.ones(b, dtype=np.int32)
@@ -193,6 +206,13 @@ class InferenceEngine:
                 # would (correctly) call the first dispatch a storm
                 args = xlacheck.stage_h2d(*args)
             np.asarray(self._forward(*args))
+            t_fwd = time.monotonic()
+            np.asarray(self._forward(*args))
+            dt = time.monotonic() - t_fwd
+            with self._lock:
+                self._dispatch_secs.append(dt)
+                if b == self.ladder.max_bucket:
+                    self._window_secs.append(dt)
         self._warm_shapes = len(self.ladder.buckets)
         # warmup over: from here any compile is a steady-state compile —
         # a typed RecompileStorm finding when the sentinel is armed
@@ -463,6 +483,8 @@ class InferenceEngine:
             self._bucket_hits[bucket] = self._bucket_hits.get(bucket, 0) + 1
             self._latencies.extend(t_done - r.t_submit for r in live)
             self._dispatch_secs.append(t_done - t_fwd)
+            if bucket == self.ladder.max_bucket:
+                self._window_secs.append(t_done - t_fwd)
             occupancy = self._boards / self._padded_boards
             write_metrics = (
                 self._metrics is not None
@@ -542,6 +564,21 @@ class InferenceEngine:
         (seconds), or None before the first one. The admission-control
         input: estimated queue wait = p50 x pending dispatch windows."""
         with self._lock:
+            if not self._dispatch_secs:
+                return None
+            return float(np.median(self._dispatch_secs))
+
+    def window_p50_s(self) -> float | None:
+        """Rolling median duration of FULL (max-bucket) dispatch windows,
+        falling back to the all-sizes median before the first full window.
+        The admission cost-per-window input: a backlog drains in
+        max-bucket windows, and under a mixed workload the all-sizes p50
+        collapses toward the small interactive dispatches — estimating a
+        large queue's drain time from 1-board forwards blinds the door
+        exactly when coexistence needs it."""
+        with self._lock:
+            if self._window_secs:
+                return float(np.median(self._window_secs))
             if not self._dispatch_secs:
                 return None
             return float(np.median(self._dispatch_secs))

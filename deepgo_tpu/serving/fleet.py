@@ -469,7 +469,7 @@ class FleetRouter:
 
     def submit(self, packed: np.ndarray, player: int, rank: int,
                tier: str | None = None, timeout_s: float | None = None,
-               block: bool = True) -> Future:
+               block: bool = True, session: str | None = None) -> Future:
         """Queue one board on the least-loaded replica; the Future ALWAYS
         resolves: the result row (possibly after transparent failovers,
         replica restarts, and background respawns), TimeoutError,
@@ -503,7 +503,7 @@ class FleetRouter:
 
         trace = tracing.start_request(fleet=self.name, tier=tier)
         wl = workload_mod.note_request(packed, player, rank, tier=tier,
-                                       fleet=self.name)
+                                       fleet=self.name, session=session)
         if self.cache is not None and not self.cache.bypass(tier):
             return self._submit_cached(packed, player, rank, tier,
                                        deadline, now, trace, wl, block)
@@ -649,8 +649,11 @@ class FleetRouter:
     def estimated_wait_s(self) -> float | None:
         """The fleet's load estimate: the MINIMUM replica estimate — a
         new request goes to the least-loaded replica, so the best replica
-        is the wait the request will actually see. None when no serving
-        replica has dispatch data yet (an idle fleet never sheds)."""
+        is the wait the request will actually see. Replicas with no
+        dispatch data yet are UNKNOWN, not idle — they are skipped, so a
+        freshly (re)spawned replica cannot zero the fleet-wide minimum
+        and blind the admission door while its siblings drown. None when
+        no serving replica has data (an idle fleet never sheds)."""
         with self._lock:
             reps = [r for r in self._replicas if r.state == "serving"]
         vals = []
@@ -659,7 +662,8 @@ class FleetRouter:
                 v = r.engine.estimated_wait_s()
             except Exception:  # a dying replica must not poison admission
                 continue
-            vals.append(0.0 if v is None else v)
+            if v is not None:
+                vals.append(v)
         return min(vals) if vals else None
 
     # -- routing -----------------------------------------------------------

@@ -50,7 +50,7 @@ DEFAULT_TIERS = ("interactive", "selfplay", "batch")
 
 def load_trace(path: str, strict: bool = True) -> list[dict]:
     """A capture directory as replayable items, oldest first: ``{t,
-    packed, player, rank, tier}`` per recorded request. ``strict``
+    packed, player, rank, tier, session}`` per recorded request. ``strict``
     raises when any request's payload is missing from the position
     store; otherwise those requests are dropped (reported by len)."""
     cap = workload_mod.load_capture(path)
@@ -67,6 +67,7 @@ def load_trace(path: str, strict: bool = True) -> list[dict]:
             "player": int(r.get("player", pos.get("player", 1))),
             "rank": int(r.get("rank", pos.get("rank", 1))),
             "tier": r.get("tier"),
+            "session": r.get("session"),
         })
     if missing and strict:
         raise WorkloadCaptureError(
@@ -107,8 +108,9 @@ class WorkloadReplayer:
         self.on_result = on_result
         self._clock = clock
         self._sleep = sleep
-        self._accepts_tier = "tier" in inspect.signature(
-            engine.submit).parameters
+        params = inspect.signature(engine.submit).parameters
+        self._accepts_tier = "tier" in params
+        self._accepts_session = "session" in params
 
     def run(self) -> dict:
         t_base = float(self.trace[0].get("t", 0.0))
@@ -129,6 +131,8 @@ class WorkloadReplayer:
             kw = {}
             if self._accepts_tier and item.get("tier") is not None:
                 kw["tier"] = item["tier"]
+            if self._accepts_session and item.get("session") is not None:
+                kw["session"] = item["session"]
             tier = str(item.get("tier") or "untiered")
             tiers[tier] = tiers.get(tier, 0) + 1
             try:

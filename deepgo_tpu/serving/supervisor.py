@@ -328,11 +328,14 @@ class SupervisedEngine:
         return np.stack([f.result() for f in futures])
 
     def estimated_wait_s(self) -> float | None:
-        """Admission control's load estimate: rolling p50 dispatch latency
-        x pending dispatch windows (queue depth / top bucket, rounded up).
-        None until the first dispatch has been measured."""
+        """Admission control's load estimate: rolling p50 FULL-window
+        dispatch latency x pending dispatch windows (queue depth / top
+        bucket, rounded up) — the backlog drains in max-bucket windows,
+        so their cost is the right multiplier even when small
+        interactive dispatches dominate the recent mix. None until the
+        first dispatch has been measured."""
         engine = self._engine
-        p50 = engine.dispatch_p50_s()
+        p50 = engine.window_p50_s()
         if p50 is None:
             return None
         depth = engine.queue_depth()

@@ -59,6 +59,16 @@ programmatically / via ``ExperimentConfig.faults``) and consulted at named
                    part of — slow@MS emulates a collective timeout (the
                    bounded retry + deadline watchdogs bound it), hard
                    faults surface typed
+  session_wal      inside the session store's fsync'd WAL append
+                   (sessions/store.py), BEFORE the ack — transients are
+                   absorbed by the loop-ingest retry policy, hard
+                   faults surface typed with the move un-acked and the
+                   in-memory game untouched
+  session_reply    per engine-reply attempt in the interactive game
+                   service (sessions/service.py), before the fleet
+                   submit — a transient burns one deadline tier and
+                   escalates to the next budget; a hard fault surfaces
+                   typed (the session state is unchanged either way)
 
 Grammar (comma-separated ``site:kind@arg`` specs):
 

@@ -346,6 +346,39 @@ class TestStats:
         assert s["warm_shapes"] == 2
         assert s["boards_per_sec"] > 0
 
+    def test_warmup_seeds_admission_latency_prior(self):
+        # under a tight-deadline flood, queued requests expire before any
+        # dispatch succeeds — if warmup left the latency window empty the
+        # admission estimate would stay None and the door could never
+        # shed. Warmup's timed post-compile forwards are the prior.
+        cfg, params = tiny()
+        with policy_engine(params, cfg,
+                           config=EngineConfig(buckets=(1, 4),
+                                               max_wait_ms=0.0)) as engine:
+            assert engine.dispatch_p50_s() is None
+            assert engine.window_p50_s() is None
+            engine.warmup()
+            assert engine.dispatch_p50_s() > 0
+            # the max-bucket rung seeded the full-window cost too
+            assert engine.window_p50_s() > 0
+
+    def test_window_p50_tracks_full_windows_not_the_mix(self):
+        # a backlog drains in max-bucket windows; 1-board interactive
+        # dispatches must not collapse the admission cost-per-window
+        cfg, params = tiny()
+        with policy_engine(params, cfg,
+                           config=EngineConfig(buckets=(1, 4),
+                                               max_wait_ms=0.0)) as engine:
+            with engine._lock:
+                engine._dispatch_secs.extend([0.001] * 40)  # 1-board mix
+                engine._window_secs.extend([0.05] * 4)      # full windows
+            assert engine.dispatch_p50_s() == pytest.approx(0.001)
+            assert engine.window_p50_s() == pytest.approx(0.05)
+            # before any full window has run, fall back to the mix
+            with engine._lock:
+                engine._window_secs.clear()
+            assert engine.window_p50_s() == pytest.approx(0.001)
+
     def test_metrics_writer_records(self, tmp_path):
         from deepgo_tpu.utils.metrics import MetricsWriter, read_jsonl
 

@@ -1,0 +1,494 @@
+"""Durable game sessions (deepgo_tpu/sessions/): the legality edges the
+replay engine omits, the WAL acked==durable contract, checkpoint
+fallback, and the two services over a stub fleet.
+
+The legality layer is pinned against ``go/replay.py`` ground truth: for
+a real recorded game, driving ``GoGame`` through the same moves must
+produce bit-identical pre-move planes — the session board is the replay
+board plus refusals, never a different board.
+"""
+
+import json
+import os
+import random
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+from deepgo_tpu.go.board import BLACK, WHITE
+from deepgo_tpu.go.replay import replay_positions
+from deepgo_tpu.go.summarize import summarize
+from deepgo_tpu.obs import workload as workload_mod
+from deepgo_tpu.sessions import (GameService, GoGame, IllegalMove,
+                                 ReplyExhausted, SessionCorrupt,
+                                 SessionNotFound, SessionStore,
+                                 SgfAnalysisService)
+from deepgo_tpu.sessions.analysis import AnalysisCursorError
+from deepgo_tpu.sgf import parse_file
+from deepgo_tpu.utils import faults
+
+PINNED_SGF = os.path.join(REPO_ROOT, "data", "sgf", "test", "1993",
+                          "2000-03-24b.sgf")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Each test starts (and leaves) with no active plan and no env."""
+    monkeypatch.delenv("DEEPGO_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---- legality edges ----
+
+
+class TestLegality:
+    def test_turn_order_and_occupied(self):
+        g = GoGame("t")
+        assert "out of turn" in g.check_move(3, 3, WHITE)
+        g.play_move(3, 3, BLACK)
+        assert "occupied" in g.check_move(3, 3, WHITE)
+        with pytest.raises(IllegalMove) as ei:
+            g.play_move(3, 3, WHITE)
+        assert ei.value.session_id == "t"
+        assert "occupied" in ei.value.reason
+
+    def test_suicide_refused(self):
+        # white walls the (0, 0) corner; black playing into it has zero
+        # liberties and captures nothing
+        g = GoGame("s", handicaps=((WHITE, 0, 1), (WHITE, 1, 0)))
+        g.play_move(10, 10, WHITE)  # handicap setup: white moves first
+        reason = g.check_move(0, 0, BLACK)
+        assert reason is not None and "suicide" in reason
+        with pytest.raises(IllegalMove):
+            g.play_move(0, 0, BLACK)
+        # the refused move mutated nothing
+        assert g.to_play == BLACK and len(g.moves) == 1
+
+    def test_capture_in_corner_is_not_suicide(self):
+        # same corner, but the "suicide" point captures a white stone
+        # first — the board engine's capture-before-liberty order
+        g = GoGame("c", handicaps=((WHITE, 0, 0), (BLACK, 0, 1),
+                                   (BLACK, 2, 0)))
+        g.play_move(10, 10, WHITE)
+        assert g.check_move(1, 0, BLACK) is None
+        kills = g.play_move(1, 0, BLACK)
+        assert kills == 1 and g.captures[BLACK] == 1
+
+    def test_positional_superko(self):
+        # a classic ko at a=(5,5)/b=(5,6): white takes, black may NOT
+        # immediately retake (the recreated position is in history) but
+        # may after a ko-threat exchange elsewhere changes the position
+        g = GoGame("ko", handicaps=(
+            (BLACK, 4, 5), (BLACK, 5, 4), (BLACK, 6, 5),   # around a
+            (WHITE, 4, 6), (WHITE, 5, 7), (WHITE, 6, 6),   # around b
+            (BLACK, 5, 6),                                 # the ko stone
+        ))
+        assert g.check_move(5, 5, WHITE) is None
+        assert g.play_move(5, 5, WHITE) == 1  # takes the ko
+        reason = g.check_move(5, 6, BLACK)
+        assert reason is not None and "superko" in reason
+        with pytest.raises(IllegalMove):
+            g.play_move(5, 6, BLACK)
+        g.play_move(15, 15, BLACK)  # ko threat
+        g.play_move(15, 16, WHITE)  # answered
+        assert g.check_move(5, 6, BLACK) is None  # retake now legal
+        assert g.play_move(5, 6, BLACK) == 1
+
+    def test_pass_pass_ends_the_game(self):
+        g = GoGame("p")
+        g.play_move(3, 3, BLACK)
+        assert g.play_pass(WHITE) is False
+        assert g.play_pass(BLACK) is True
+        assert g.over
+        assert "over" in g.check_move(4, 4, WHITE)
+        with pytest.raises(IllegalMove):
+            g.play_pass(WHITE)
+        assert g.legal_points() == []
+
+    def test_board_pinned_to_replay_ground_truth(self):
+        # the session board must evolve bit-identically to the replay
+        # engine for any legal recorded sequence: same planes, move by
+        # move, over a real game
+        sgf_game = parse_file(PINNED_SGF)
+        g = GoGame("pin", handicaps=tuple(
+            (m.player, m.x, m.y) for m in sgf_game.handicaps))
+        applied = 0
+        for packed, move in replay_positions(sgf_game):
+            assert np.array_equal(summarize(g.stones, g.age), packed), \
+                f"session board diverged from replay before move {applied}"
+            if g.check_move(move.x, move.y, move.player) is not None:
+                break  # a non-alternating record ends the pin, not the test
+            g.play_move(move.x, move.y, move.player)
+            applied += 1
+            if applied >= 80:
+                break
+        assert applied >= 40
+
+    def test_snapshot_digest_roundtrip(self):
+        g = GoGame("r", handicaps=((BLACK, 3, 3),))
+        g.play_move(10, 10, WHITE)
+        g.play_move(4, 4, BLACK)
+        g.play_pass(WHITE)
+        clone = GoGame.from_snapshot(g.snapshot())
+        assert clone.digest() == g.digest()
+        # the clone is live state, not a frozen copy
+        clone.play_move(5, 5, BLACK)
+        assert clone.digest() != g.digest()
+
+
+# ---- the WAL store ----
+
+
+def drive(store, sid="g"):
+    store.open_session(sid)
+    store.append_move(sid, BLACK, x=3, y=3)
+    store.append_move(sid, WHITE, x=15, y=15)
+    store.append_move(sid, BLACK, x=4, y=3)
+    return store.get(sid).digest()
+
+
+class TestSessionStore:
+    def test_acked_is_durable_without_checkpoint(self, tmp_path):
+        s1 = SessionStore(str(tmp_path), checkpoint_every=1000)
+        digest = drive(s1)
+        s1.close(final_checkpoint=False)  # crash: WAL only, no compaction
+        s2 = SessionStore(str(tmp_path), checkpoint_every=1000)
+        assert s2.recovery["wal_records_applied"] == 4
+        assert s2.recovery["sessions"] == 1
+        assert s2.get("g").digest() == digest
+        # appends continue from the recovered seq, no overlap
+        assert s2.append_move("g", WHITE, x=16, y=16) == 5
+
+    def test_torn_wal_tail_is_dropped(self, tmp_path):
+        s1 = SessionStore(str(tmp_path), checkpoint_every=1000)
+        digest = drive(s1)
+        s1.close(final_checkpoint=False)
+        (_, wal), = [(q, p) for q, p in s1._wal_paths()]
+        with open(wal, "ab") as f:  # lint: allow[atomic-write] simulating a torn fsync'd append tail
+            f.write(b'{"kind":"session_move","seq":5,"ses')
+        s2 = SessionStore(str(tmp_path), checkpoint_every=1000)
+        assert s2.recovery["torn_tail"] is True
+        assert s2.get("g").digest() == digest
+        assert not s2.stats()["corrupt_sessions"]
+
+    def test_checkpoint_compacts_wal_and_prunes(self, tmp_path):
+        s = SessionStore(str(tmp_path), checkpoint_every=2,
+                         keep_checkpoints=2)
+        drive(s)  # 4 records with checkpoint_every=2: compactions ran
+        names = sorted(os.listdir(tmp_path))
+        assert not [n for n in names if n.startswith("wal-")]
+        ckpts = [n for n in names if n.startswith("ckpt-")]
+        assert 1 <= len(ckpts) <= 2
+        for _ in range(4):
+            sid = f"x{_}"
+            s.open_session(sid)
+            s.append_move(sid, BLACK, x=_, y=0)
+        ckpts = [n for n in os.listdir(tmp_path) if n.startswith("ckpt-")]
+        assert len(ckpts) <= 2  # pruned to keep_checkpoints
+        s.close()
+
+    def test_corrupt_checkpoint_falls_back_to_older(self, tmp_path):
+        s = SessionStore(str(tmp_path), checkpoint_every=1000,
+                         keep_checkpoints=3)
+        digest_a = drive(s)
+        s.checkpoint()
+        s.append_move("g", WHITE, x=16, y=16)
+        s.checkpoint()
+        s.close(final_checkpoint=False)
+        newest = s._ckpt_paths()[0][1]
+        with open(newest, "r+b") as f:  # lint: allow[atomic-write] corrupting a checkpoint on purpose
+            f.seek(20)
+            f.write(b"XXXXXX")
+        s2 = SessionStore(str(tmp_path))
+        assert s2.recovery["checkpoints_skipped"] == 1
+        assert s2.recovery["checkpoint_seq"] == 4
+        assert s2.get("g").digest() == digest_a
+
+    def test_unreplayable_wal_falls_back_to_checkpoint(self, tmp_path):
+        s = SessionStore(str(tmp_path), checkpoint_every=1000)
+        digest_ckpt = drive(s)
+        s.checkpoint()
+        s.append_move("g", WHITE, x=16, y=16)
+        s.close(final_checkpoint=False)
+        (_, wal), = [(q, p) for q, p in s._wal_paths()]
+        bad = {"kind": "session_move", "seq": 6, "session": "g",
+               "player": WHITE, "x": 3, "y": 3}  # occupied: cannot apply
+        with open(wal, "ab") as f:  # lint: allow[atomic-write] appending a poisoned WAL record
+            f.write((json.dumps(bad) + "\n").encode())
+        s2 = SessionStore(str(tmp_path))
+        # find_latest_valid style: the session falls back to its last
+        # checkpointed snapshot instead of going corrupt
+        assert s2.recovery["restored_from_checkpoint"] == ["g"]
+        assert not s2.stats()["corrupt_sessions"]
+        assert s2.get("g").digest() == digest_ckpt
+
+    def test_move_for_unopened_session_is_corrupt(self, tmp_path):
+        s = SessionStore(str(tmp_path), checkpoint_every=1000)
+        drive(s)
+        s.close(final_checkpoint=False)
+        (_, wal), = [(q, p) for q, p in s._wal_paths()]
+        bad = {"kind": "session_move", "seq": 5, "session": "ghost",
+               "player": BLACK, "x": 0, "y": 0}
+        with open(wal, "ab") as f:  # lint: allow[atomic-write] appending a poisoned WAL record
+            f.write((json.dumps(bad) + "\n").encode())
+        s2 = SessionStore(str(tmp_path))
+        assert s2.recovery["corrupt"] == ["ghost"]
+        with pytest.raises(SessionCorrupt):
+            s2.get("ghost")
+        assert s2.get("g") is not None  # the blast radius is one session
+
+    def test_wal_transient_absorbed_hard_fault_unacked(self, tmp_path):
+        s = SessionStore(str(tmp_path), checkpoint_every=1000)
+        s.open_session("g")
+        faults.install("session_wal:transient@2")
+        assert s.append_move("g", BLACK, x=3, y=3) == 2  # acked anyway
+        assert s.stats()["wal_retries"] == 2
+        faults.reset()
+        faults.install("session_wal:fail@1")
+        with pytest.raises(faults.InjectedFailure):
+            s.append_move("g", WHITE, x=4, y=4)
+        # nothing acked, nothing applied: seq and board are untouched
+        assert s.seq == 2
+        assert len(s.get("g").moves) == 1
+        faults.reset()
+        assert s.append_move("g", WHITE, x=4, y=4) == 3
+        s.close(final_checkpoint=False)
+        s2 = SessionStore(str(tmp_path))
+        assert s2.get("g").digest() == s.get("g").digest()
+
+    def test_typed_lookup_errors(self, tmp_path):
+        s = SessionStore(str(tmp_path))
+        with pytest.raises(SessionNotFound):
+            s.get("nope")
+        with pytest.raises(SessionNotFound):
+            s.append_move("nope", BLACK, x=0, y=0)
+        s.open_session("g")
+        with pytest.raises(IllegalMove):
+            s.append_move("g", WHITE, x=0, y=0)  # out of turn
+        s.close()
+
+
+# ---- the services, over a stub fleet ----
+
+
+class EngineOverloaded(Exception):
+    """Local stand-in: the service classifies shed errors by type NAME,
+    exactly like the real fleet surface."""
+
+
+class StubFleet:
+    def __init__(self, errors=(), row=None):
+        self.errors = list(errors)
+        self.calls: list[dict] = []
+        self.row = row
+
+    def submit(self, packed, player, rank, tier=None, timeout_s=None,
+               session=None, block=True):
+        self.calls.append({"tier": tier, "timeout_s": timeout_s,
+                           "session": session, "player": player})
+        if self.errors:
+            raise self.errors.pop(0)
+        fut = Future()
+        row = self.row if self.row is not None \
+            else np.zeros(361, np.float32)
+        fut.set_result(row)
+        return fut
+
+
+def make_service(tmp_path, **kw):
+    fleet = kw.pop("fleet", StubFleet())
+    store = SessionStore(os.path.join(str(tmp_path), "store"),
+                         checkpoint_every=1000)
+    svc = GameService(fleet, store, sleep=lambda d: None,
+                      rng=random.Random(1), **kw)
+    return fleet, store, svc
+
+
+class TestGameService:
+    def test_play_acks_then_engine_replies(self, tmp_path):
+        fleet, store, svc = make_service(tmp_path)
+        sid = svc.new_game("live")
+        out = svc.play(sid, 3, 3)
+        assert out["seq"] == 2 and "reply" in out
+        # zero logits + legality mask: argmax is the first legal point
+        assert (out["reply"]["x"], out["reply"]["y"]) == (0, 0)
+        assert store.get(sid).moves[-1] == {"player": WHITE, "x": 0, "y": 0}
+        call, = fleet.calls
+        assert call["tier"] == "interactive"
+        assert call["session"] == sid
+        assert call["timeout_s"] == svc.budgets_s[0]
+        svc.close()
+
+    def test_illegal_client_move_changes_nothing(self, tmp_path):
+        fleet, store, svc = make_service(tmp_path)
+        sid = svc.new_game()
+        svc.play(sid, 3, 3)
+        before = store.get(sid).digest()
+        game = store.get(sid)
+        game_to_play = game.to_play
+        with pytest.raises(IllegalMove):
+            store.append_move(sid, game_to_play, x=3, y=3)  # occupied
+        assert store.get(sid).digest() == before
+        assert not fleet.calls[1:]  # no reply for a refused move
+        svc.close()
+
+    def test_deadline_tiers_escalate_then_succeed(self, tmp_path):
+        fleet = StubFleet(errors=[EngineOverloaded("door"),
+                                  TimeoutError("deadline")])
+        fleet, store, svc = make_service(tmp_path, fleet=fleet)
+        sid = svc.new_game()
+        out = svc.play(sid, 3, 3)
+        assert "reply" in out
+        assert svc.reply_retries == 2
+        # each attempt got the next (looser) budget tier
+        assert [c["timeout_s"] for c in fleet.calls] == \
+            list(svc.budgets_s)
+        svc.close()
+
+    def test_reply_exhausted_leaves_session_retriable(self, tmp_path):
+        fleet = StubFleet(errors=[EngineOverloaded("x")] * 3)
+        fleet, store, svc = make_service(tmp_path, fleet=fleet)
+        sid = svc.new_game()
+        store.append_move(sid, BLACK, x=3, y=3)
+        before = store.get(sid).digest()
+        with pytest.raises(ReplyExhausted):
+            svc.engine_reply(sid)
+        assert store.get(sid).digest() == before
+        out = svc.engine_reply(sid)  # stub errors drained: retry works
+        assert out["player"] == WHITE
+        svc.close()
+
+    def test_reply_fault_site_burns_one_tier(self, tmp_path):
+        fleet, store, svc = make_service(tmp_path)
+        sid = svc.new_game()
+        store.append_move(sid, BLACK, x=3, y=3)
+        faults.install("session_reply:transient@1")
+        out = svc.engine_reply(sid)
+        assert out["player"] == WHITE
+        assert svc.reply_retries == 1
+        # transient burned the first tier BEFORE the submit reached the
+        # fleet: one call, made with the second budget
+        assert [c["timeout_s"] for c in fleet.calls] == \
+            [svc.budgets_s[1]]
+        svc.close()
+
+    def test_health_composes(self, tmp_path):
+        fleet, store, svc = make_service(tmp_path)
+        svc.new_game("a")
+        h = svc.health()
+        assert h["healthy"] is True and h["open_sessions"] == 1
+        store.corrupt["ghost"] = "damaged"
+        assert svc.health()["healthy"] is False
+        svc.close()
+
+
+class TestSgfAnalysis:
+    def test_scan_annotates_and_flags_blunders(self, tmp_path):
+        d = os.path.join(str(tmp_path), "sgf")
+        os.makedirs(d)
+        with open(PINNED_SGF, "rb") as f:
+            body = f.read()
+        with open(os.path.join(d, "a.sgf"), "wb") as f:  # lint: allow[atomic-write] building a test corpus
+            f.write(body)
+        fleet = StubFleet(row=np.full(361, -10.0, np.float64))
+        svc = SgfAnalysisService(fleet, os.path.join(str(tmp_path), "out"),
+                                 blunder_top=0, sleep=lambda d: None)
+        report = svc.run(d)
+        assert report["files_done"] == 1
+        assert report["positions"] == report["annotated"] > 50
+        # uniform row: every move is rank 1 at logp -10 < blunder_logp,
+        # and blunder_top=0 makes every move a blunder
+        assert report["blunders"] == report["annotated"]
+        assert all(c["tier"] == "batch" and c["session"] == "scan:a.sgf"
+                   for c in fleet.calls)
+        with open(svc.sink.path, encoding="utf-8") as f:
+            kinds = [json.loads(line)["kind"] for line in f]
+        assert kinds.count("session_scan") == 1
+        assert kinds.count("session_annotation") == report["annotated"]
+        svc.close()
+
+    def test_cursor_resumes_and_never_reannotates(self, tmp_path):
+        d = os.path.join(str(tmp_path), "sgf")
+        os.makedirs(d)
+        with open(PINNED_SGF, "rb") as f:
+            body = f.read()
+        with open(os.path.join(d, "a.sgf"), "wb") as f:  # lint: allow[atomic-write] building a test corpus
+            f.write(body)
+        out = os.path.join(str(tmp_path), "out")
+        fleet = StubFleet()
+        svc = SgfAnalysisService(fleet, out, sleep=lambda d: None)
+        first = svc.run(d, limit_positions=50)
+        assert first["stopped_early"] and first["positions"] == 50
+        svc.close()
+        fleet2 = StubFleet()
+        svc2 = SgfAnalysisService(fleet2, out, sleep=lambda d: None)
+        second = svc2.run(d)
+        assert second["files_done"] == 1
+        total = sum(1 for _ in replay_positions(parse_file(PINNED_SGF)))
+        # every move annotated exactly once across the two runs
+        assert first["annotated"] + second["annotated"] == total
+        third = svc2.run(d)
+        assert third["positions"] == 0 and third["files_resumed_past"] == 1
+        svc2.close()
+
+    def test_sheds_are_absorbed_outcomes(self, tmp_path):
+        d = os.path.join(str(tmp_path), "sgf")
+        os.makedirs(d)
+        with open(PINNED_SGF, "rb") as f:
+            body = f.read()
+        with open(os.path.join(d, "a.sgf"), "wb") as f:  # lint: allow[atomic-write] building a test corpus
+            f.write(body)
+
+        class SheddingFleet(StubFleet):
+            def submit(self, *a, **kw):
+                raise EngineOverloaded("door")
+
+        svc = SgfAnalysisService(SheddingFleet(),
+                                 os.path.join(str(tmp_path), "out"),
+                                 attempts=1, sleep=lambda d: None)
+        report = svc.run(d, limit_positions=20)
+        assert report["outcomes"] == {"shed": 20}
+        assert report["annotated"] == 0
+        svc.close()
+
+    def test_bogus_cursor_is_typed(self, tmp_path):
+        out = os.path.join(str(tmp_path), "out")
+        os.makedirs(out)
+        with open(os.path.join(out, "cursor.json"), "w",  # lint: allow[atomic-write] writing a bogus cursor fixture
+                  encoding="utf-8") as f:
+            f.write("[1, 2, 3]")
+        svc = SgfAnalysisService(StubFleet(), out, sleep=lambda d: None)
+        with pytest.raises(AnalysisCursorError):
+            svc.run(str(tmp_path))
+        svc.close()
+
+
+# ---- the workload observatory's session label ----
+
+
+class TestSessionWorkload:
+    def test_characterize_reports_per_session_burstiness(self):
+        recs = []
+        t = 0.0
+        for i in range(12):  # periodic session traffic: burstiness < 0
+            t += 0.04
+            recs.append({"digest": f"d{i}", "tier": "interactive",
+                         "session": "live-0", "t": t})
+        rng = random.Random(7)
+        t = 0.0
+        for i in range(40):  # bursty scan traffic
+            t += rng.choice((0.001, 0.001, 0.001, 0.3))
+            recs.append({"digest": f"s{i}", "tier": "batch",
+                         "session": "scan:a.sgf", "t": t})
+        recs.append({"digest": "x", "tier": "batch", "t": 1.0})  # unlabeled
+        out = workload_mod.characterize(recs)
+        sess = out["sessions"]
+        assert sess["count"] == 2
+        assert sess["labeled_requests"] == 52
+        assert sess["top"]["live-0"]["requests"] == 12
+        assert sess["top"]["live-0"]["burstiness"] < 0
+        assert sess["top"]["scan:a.sgf"]["burstiness"] > 0
