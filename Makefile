@@ -5,7 +5,7 @@
     verify-obs \
     verify-slo verify-trace verify-loop verify-analysis verify-xlacheck \
     verify-cost verify-quant verify-telemetry verify-workload \
-    verify-chaos verify-cache verify-sessions bench bench-gate smoke clean
+    verify-chaos verify-cache verify-sessions verify-search bench bench-gate smoke clean
 
 native:
 	$(MAKE) -C native
@@ -88,7 +88,11 @@ verify-cache:  # position cache: shared digest/augment table pinning, canonical-
 verify-sessions:  # durable game sessions: superko/suicide/pass-pass legality pinned to replay ground truth, WAL acked==durable + torn-tail + checkpoint fallback, deadline-tiered replies, resumable bulk scan, per-session workload label
 	JAX_PLATFORMS=cpu python -m pytest tests/test_sessions.py -q
 
-verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-remesh verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload verify-chaos verify-cache verify-sessions  # the full failure-model suite
+verify-search:  # batched PUCT search: fixed-seed determinism, virtual-loss accounting, canonical-frame remap bitwise through all 8 dihedral views, anytime deadline fallback, search agent + selfplay selector, then the two-leg bench gate (transposition hit rate + replica-kill move_lost==0)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_search.py -q
+	JAX_PLATFORMS=cpu python bench.py --mode search
+
+verify: lint verify-faults verify-serving verify-resilience verify-fleet verify-distributed verify-remesh verify-obs verify-slo verify-trace verify-loop verify-analysis verify-xlacheck verify-cost verify-quant verify-telemetry verify-workload verify-chaos verify-cache verify-sessions verify-search  # the full failure-model suite
 
 bench:
 	python bench.py

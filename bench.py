@@ -131,6 +131,7 @@ _METRIC_OF = {
     "loop": ("loop_games_per_hour", "games/hour"),
     "chaos": ("chaos_brownout_interactive_good_frac", "frac within SLO"),
     "mixed": ("mixed_session_interactive_good_frac", "frac within SLO"),
+    "search": ("search_simulations_per_sec", "simulations/sec"),
 }
 
 
@@ -2507,6 +2508,199 @@ def _bench_mixed(on_tpu: bool) -> dict:
     return result
 
 
+def _bench_search(on_tpu: bool) -> dict:
+    """The deep-search-as-a-service gate (ISSUE 20, deepgo_tpu/search,
+    docs/search.md).
+
+    Two legs, one verdict:
+
+      clean   concurrent PUCT searches from overlapping openings share
+              one transposition table over a live 2-replica fleet, leaf
+              waves riding the interactive tier with the workload
+              recorder armed. Graded on: transposition hit rate >= 0.5
+              (the tree IS the content-addressed cache), every search
+              returns a legal non-fallback move inside its deadline,
+              and the capture distinguishes search-shaped traffic
+              (search:<id> labels -> the transposition dup ratio).
+      chaos   a replica is killed mid-search (the scenario scheduler's
+              kill event; replicas run max_restarts=0 so the kill
+              crosses into the FLEET domain: failover + respawn). The
+              anytime contract must still produce a legal move within
+              the deadline — move_lost == 0 — with the kill actually
+              absorbed (failover or respawn counters fired).
+
+    The headline value is the clean leg's simulations/sec;
+    ``chaos_gate`` carries the verdict (enforced unconditionally by
+    ``_exit_gate``, with or without --gate)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from deepgo_tpu.chaos import (FaultEvent, Scenario, ScenarioScheduler,
+                                  defended_config)
+    from deepgo_tpu.models import policy_cnn
+    from deepgo_tpu.obs import workload as workload_mod
+    from deepgo_tpu.search import Search, SearchConfig, TranspositionTable
+    from deepgo_tpu.selfplay import GameState, apply_move
+    from deepgo_tpu.serving import (EngineConfig, FleetConfig,
+                                    SupervisorConfig, fleet_policy_engine)
+
+    reasons: list = []
+    work = tempfile.mkdtemp(prefix="bench-search-")
+    cfg = policy_cnn.CONFIGS["small"]
+    params = policy_cnn.init(jax.random.key(0), cfg)
+    buckets = (1, 8, 32, 128) if on_tpu else (1, 8, 32)
+
+    def make_fleet():
+        f = fleet_policy_engine(
+            params, cfg, replicas=2,
+            config=EngineConfig(buckets=buckets, max_wait_ms=2.0),
+            fleet=defended_config(FleetConfig(respawn_base_s=0.01,
+                                              respawn_cap_s=0.05)),
+            supervisor=SupervisorConfig(max_restarts=0,
+                                        backoff_base_s=0.01,
+                                        backoff_cap_s=0.05),
+            name="search")
+        f.warmup()
+        return f
+
+    # ---- leg 1: concurrent searches, one transposition table ----------
+    sims = 96 if on_tpu else 48
+    openings: tuple = ((), ((3, 3),), ((3, 3), (15, 15)), ())
+    fleet = make_fleet()
+    workload_mod.configure_workload(
+        capture_dir=os.path.join(work, "capture"), store_positions=False)
+    table = TranspositionTable()
+    results: list = [None] * len(openings)
+
+    def one(i: int) -> None:
+        g = GameState()
+        for x, y in openings[i]:
+            apply_move(g, x, y)
+        s = Search(fleet, SearchConfig(simulations=sims, wave_size=16,
+                                       tier="interactive",
+                                       deadline_s=120.0),
+                   table=table)
+        try:
+            results[i] = s.search(g)
+        except Exception:  # noqa: BLE001 — graded as a lost search
+            results[i] = None
+
+    threads = [threading.Thread(target=one, args=(i,),
+                                name=f"bench-search-{i}", daemon=True)
+               for i in range(len(openings))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    clean_wall = time.perf_counter() - t0
+    done = [r for r in results if r is not None]
+    sims_done = sum(r.simulations for r in done)
+    sims_per_sec = round(sims_done / clean_wall, 2) if clean_wall else 0.0
+    tt = table.stats()
+    hit_rate = round(tt["hits"] / max(1, tt["lookups"]), 4)
+    occupancy = round(float(np.mean([r.wave_occupancy for r in done])), 4) \
+        if done else 0.0
+    workload_mod.disable_workload()
+    cap = workload_mod.load_capture(os.path.join(work, "capture"))
+    search_block = workload_mod.characterize(
+        cap["requests"]).get("search") or {}
+    fleet.close()
+
+    if len(done) < len(openings):
+        reasons.append(f"clean: {len(openings) - len(done)} of "
+                       f"{len(openings)} concurrent searches died")
+    if any(r.fallback for r in done):
+        reasons.append("clean: a search degraded to the fallback move "
+                       "with no chaos running")
+    if any(r.move < 0 for r in done):
+        reasons.append("clean: a search passed from the opening")
+    if not all(r.deadline_met for r in done):
+        reasons.append("clean: a search blew its deadline unperturbed")
+    if hit_rate < 0.5:
+        reasons.append(f"clean: transposition hit rate {hit_rate:.2%} "
+                       "< 50% across concurrent searches — the shared "
+                       "tree is not deduplicating")
+    if search_block.get("searches", 0) < len(openings):
+        reasons.append("clean: the workload capture saw "
+                       f"{search_block.get('searches', 0)} search "
+                       "label(s) — search-shaped traffic is not "
+                       "distinguishable")
+
+    # ---- leg 2: replica kill mid-search, the move still lands ---------
+    fleet2 = make_fleet()
+    searcher = Search(fleet2, SearchConfig(simulations=sims, wave_size=8,
+                                           tier="interactive"))
+    scenario = Scenario(name="search-kill", seed=7, events=(
+        FaultEvent(at_s=0.2, kind="kill", replica=0),))
+    scheduler = ScenarioScheduler(scenario, fleet_name="search")
+    deadline_s = 60.0 if on_tpu else 120.0
+    scheduler.start()
+    t0 = time.perf_counter()
+    try:
+        chaos_res = searcher.search(GameState(), deadline_s=deadline_s)
+    except Exception as e:  # noqa: BLE001 — graded as a lost move
+        chaos_res = None
+        reasons.append(f"chaos: the search raised instead of honoring "
+                       f"the anytime contract: {type(e).__name__}")
+    chaos_wall = time.perf_counter() - t0
+    scheduler.stop()
+    fstats = fleet2.stats()["fleet"]
+    fleet2.close()
+    move_lost = int(chaos_res is None or chaos_res.move < 0)
+    if move_lost:
+        reasons.append("chaos: the replica kill lost the move "
+                       f"(move={getattr(chaos_res, 'move', None)})")
+    if chaos_res is not None and chaos_wall > deadline_s + 1.0:
+        reasons.append(f"chaos: the move took {chaos_wall:.1f}s against "
+                       f"a {deadline_s:.0f}s deadline")
+    if not scheduler.executed:
+        reasons.append("chaos: the kill event never fired")
+    elif not (fstats.get("failovers") or fstats.get("respawns")):
+        reasons.append("chaos: the kill fired but neither failover nor "
+                       "respawn engaged — the fault missed the fleet")
+
+    metric, unit = _METRIC_OF["search"]
+    result = {
+        "bench": "search", "metric": metric, "unit": unit,
+        "value": sims_per_sec,
+        "clean": {
+            "searches": len(openings),
+            "simulations": sims_done,
+            "lost": sum(r.lost for r in done),
+            "wall_s": round(clean_wall, 3),
+            "simulations_per_sec": sims_per_sec,
+            "wave_occupancy": occupancy,
+            "transposition": {**tt, "hit_rate": hit_rate},
+            "deadline_met": all(r.deadline_met for r in done),
+            "moves": [r.move for r in done],
+            "search_workload": search_block,
+        },
+        "chaos": {
+            "scenario": scenario.to_dict(),
+            "move": None if chaos_res is None else chaos_res.move,
+            "move_lost": move_lost,
+            "simulations": 0 if chaos_res is None
+            else chaos_res.simulations,
+            "lost_simulations": 0 if chaos_res is None else chaos_res.lost,
+            "wall_s": round(chaos_wall, 3),
+            "deadline_s": deadline_s,
+            "deadline_met": bool(chaos_res and chaos_res.deadline_met),
+            "fallback": bool(chaos_res and chaos_res.fallback),
+            "failovers": fstats.get("failovers"),
+            "respawns": fstats.get("respawns"),
+        },
+        "chaos_gate": {"pass": not reasons, "reasons": reasons},
+    }
+    if reasons:
+        result["error"] = "; ".join(reasons[:3])
+    shutil.rmtree(work, ignore_errors=True)
+    return result
+
+
 def _mixed_script(i: int) -> list:
     """A deterministic per-session move preference order (the same
     seeded-shuffle idiom as sessions/child.py, offset so the bench's
@@ -2525,7 +2719,7 @@ def main() -> None:
     ap.add_argument("--mode", default="inference",
                     choices=["inference", "train", "latency", "large",
                              "serving", "distributed", "loop", "chaos",
-                             "mixed"])
+                             "mixed", "search"])
     ap.add_argument("--faults", nargs="?", const="__default__",
                     default=None, metavar="SPEC",
                     help="(--mode serving / distributed / loop) chaos run: "
@@ -2669,6 +2863,8 @@ def main() -> None:
                                   replay_speed=args.replay_speed)
         elif args.mode == "mixed":
             result = _bench_mixed(on_tpu)
+        elif args.mode == "search":
+            result = _bench_search(on_tpu)
         elif args.mode == "loop":
             result = _bench_loop(on_tpu, args.faults)
         else:

@@ -623,6 +623,104 @@ class Value2PlyAgent(ValueSearchAgent):
                             tie_scale=1e-4)
 
 
+class SearchAgent(PolicyAgent):
+    """Full PUCT tree search over the serving fleet (``mcts:`` spec).
+
+    The deep end of the policy-guides-search ladder (docs/search.md):
+    where the ``search:``/``search2:``/``value2:`` family re-ranks a
+    handful of candidates 1-2 plies deep, this agent runs a
+    virtual-loss wave-batched MCTS (deepgo_tpu.search) whose leaf
+    evaluations ride the shared serving engine as batched futures and
+    whose transposition table is keyed on the canonical position
+    digests — so both sides of a match, and every symmetry of every
+    transposition, share forwards through the content-addressed cache.
+    The table persists across moves and games: tree reuse is a table
+    hit. With value params the leaves are scored by the value net
+    (``mcts:POLICY:VALUE``); without, the search is prior-guided with
+    terminal-only values.
+
+    Deterministic at ``temperature=0`` given a fixed simulation budget
+    and a deterministic evaluator (the Elo gate's requirement); ``rng``
+    only matters for root Dirichlet noise / visit sampling, which the
+    arena leaves off.
+    """
+
+    def __init__(self, params, cfg, value_params=None, value_cfg=None,
+                 name: str = "mcts", simulations: int = 128,
+                 search_config=None, value_engine=None, table=None, **kw):
+        if kw.get("temperature", 0.0):
+            raise ValueError("SearchAgent selects by visit count; "
+                             "temperature is not supported in the arena")
+        super().__init__(params, cfg, name=name, **kw)
+        from .search import Search, SearchConfig, TranspositionTable
+
+        self.simulations = simulations
+        if value_engine is None and value_params is not None:
+            value_engine = _DirectValue(value_params, value_cfg)
+        self.value_engine = value_engine
+        cfg_s = search_config or SearchConfig(
+            simulations=simulations, rank=self.rank, tier="interactive")
+        self.search_config = cfg_s
+        self.table = table if table is not None else TranspositionTable(
+            cfg_s.max_nodes)
+        engine = self.engine if self.engine is not None \
+            else _DirectSubmit(self)
+        self._search = Search(engine, cfg_s, table=self.table,
+                              value_engine=value_engine)
+
+    def select_moves(self, packed, players, legal, rng):
+        from .search import game_from_packed
+
+        moves = np.full(len(packed), -1, dtype=np.int64)
+        for i in range(len(packed)):
+            g = game_from_packed(packed[i], int(players[i]), legal[i])
+            r = self._search.search(g, simulations=self.simulations,
+                                    root_legal=legal[i])
+            moves[i] = r.move
+        return moves
+
+
+class _DirectSubmit:
+    """Engine-shaped adapter over the agent's direct forward path: each
+    leaf is one (bucket-padded) forward resolved into an
+    already-completed future. The no-engine smoke path — real searches
+    should share a micro-batching engine so waves coalesce."""
+
+    def __init__(self, agent: PolicyAgent):
+        self._agent = agent
+
+    def submit(self, packed, player, rank):
+        from concurrent.futures import Future
+
+        a = self._agent
+        row = batched_log_probs(
+            a._predict, a.params, np.asarray(packed)[None],
+            np.array([player], dtype=np.int32),
+            np.array([rank], dtype=np.int32))[0]
+        f = Future()
+        f.set_result(np.asarray(row))
+        return f
+
+
+class _DirectValue:
+    """``evaluate``-shaped adapter over a direct value forward (the same
+    ladder-padded path ValueSearchAgent uses without an engine)."""
+
+    def __init__(self, value_params, value_cfg):
+        from .models.serving import make_value_fn
+
+        self._params = value_params
+        self._win_prob = make_value_fn(value_cfg)
+
+    def evaluate(self, boards, to_move, ranks):
+        from .serving import bucketed_forward, ladder_for
+
+        return bucketed_forward(
+            lambda pk, pl, rk: self._win_prob(self._params, pk, pl, rk),
+            boards, np.asarray(to_move, dtype=np.int32),
+            np.asarray(ranks, dtype=np.int32), ladder_for(len(boards)))
+
+
 def _policy_engine_for(params, cfg, use_engine, fleet: int = 1,
                        variant: str = "f32"):
     """The shared policy engine for this checkpoint, or None. Agents built
@@ -653,7 +751,7 @@ def _policy_engine_for(params, cfg, use_engine, fleet: int = 1,
 
 def _make_agent(spec: str, seed: int, temperature: float = 0.0,
                 rank: int = 9, use_engine=False, fleet: int = 1,
-                variant: str = "f32") -> Agent:
+                variant: str = "f32", search_sims: int = 128) -> Agent:
     """``use_engine``: False (direct ladder path), True (shared
     micro-batching engine), or "supervised" (shared engine under the
     resilience supervisor). ``fleet >= 2`` upgrades the shared engines to
@@ -719,6 +817,32 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
                    engine=_policy_engine_for(params, cfg, use_engine,
                                              fleet=fleet),
                    value_engine=value_engine)
+    if spec.startswith("mcts:"):
+        from .models.serving import load_policy, load_value
+
+        # mcts:POLICY_CKPT[:VALUE_CKPT] — full PUCT tree search
+        # (deepgo_tpu.search) with the policy as prior and, when given,
+        # the value net at the leaves. Always rides the shared
+        # micro-batching engine: wave-batched leaf futures are the point.
+        parts = spec.split(":")
+        _, params, cfg = load_policy(parts[1])
+        vparams = vcfg = None
+        if len(parts) > 2:
+            _, vparams, vcfg = load_value(parts[2])
+        value_engine = None
+        if vparams is not None and use_engine:
+            from .serving import shared_value_engine
+
+            value_engine = shared_value_engine(
+                vparams, vcfg, supervised=use_engine == "supervised",
+                fleet=fleet)
+        return SearchAgent(params, cfg, vparams, vcfg, rank=rank,
+                           simulations=search_sims,
+                           engine=_policy_engine_for(params, cfg,
+                                                     use_engine or True,
+                                                     fleet=fleet,
+                                                     variant=variant),
+                           value_engine=value_engine)
     if spec.startswith("model:"):  # random-init policy, for smoke runs
         cfg = policy_cnn.CONFIGS[spec.split(":", 1)[1]]
         params = policy_cnn.init(jax.random.key(seed), cfg)
@@ -731,4 +855,4 @@ def _make_agent(spec: str, seed: int, temperature: float = 0.0,
         f"unknown agent spec {spec!r} "
         "(use random | heuristic | oneply | checkpoint:PATH | search:PATH "
         "| search2:PATH | value:POLICY:VALUE | value2:POLICY:VALUE "
-        "| model:NAME)")
+        "| mcts:POLICY[:VALUE] | model:NAME)")

@@ -127,15 +127,20 @@ class LintConfig:
     # event) in ISSUE 17; reshard_* (the resharding restore's event
     # stream next to the deepgo_reshard_* metrics) in ISSUE 18;
     # session_* (the durable game-session WAL records and the bulk-scan
-    # annotation stream) in ISSUE 19.
+    # annotation stream) in ISSUE 19; search_* (the PUCT search verdict
+    # stream `cli trace` joins on) in ISSUE 20.
     grammar_prefixes: tuple = ("deepgo_", "obs_", "loop_", "fleet_",
                                "trace_", "lineage_", "cost_", "ts_",
                                "anomaly_", "workload_", "cache_",
-                               "reshard_", "session_")
+                               "reshard_", "session_", "search_")
     # doc tokens that share a grammar prefix but are not metrics/events:
     # bench JSON keys and similar
     grammar_ignore: frozenset = frozenset({
         "obs_registry", "loop_games_per_hour", "trace_id",
+        # the bench --mode search headline metric key (a BENCH json
+        # field, not a JSONL event kind), and search_id (a record field
+        # inside search_request, same shape as trace_id)
+        "search_simulations_per_sec", "search_id",
         # flight-dump section / JSON keys that share the trace_ prefix
         # but are not JSONL event kinds
         "trace_exemplars",

@@ -10,8 +10,8 @@ the match CLI.
 
 from .agents import (  # noqa: F401
     Agent, HeuristicAgent, OnePlyAgent, PolicyAgent, PolicySearchAgent,
-    RandomAgent, TwoPlyAgent, Value2PlyAgent, ValueSearchAgent, W_KILL,
-    W_LADDER, W_LIB,
+    RandomAgent, SearchAgent, TwoPlyAgent, Value2PlyAgent,
+    ValueSearchAgent, W_KILL, W_LADDER, W_LIB,
     W_OPP_LIB, W_SAVE, W_SELF_ATARI, _apply_and_summarize,
     _argmax_random_tiebreak, _make_agent, _no_own_eyes, _oneply_scores,
     _play_candidates, _policy_engine_for, _tactical_grids, _topk_mask,

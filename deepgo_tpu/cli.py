@@ -278,7 +278,8 @@ def cmd_serve(args) -> None:
         from .sessions import GameService, SessionStore
 
         store = SessionStore(args.sessions)
-        session_service = GameService(fleet, store)
+        session_service = GameService(fleet, store,
+                                      search_sims=args.search_sims)
         exporter.add_health("sessions", session_service.health)
         rec = store.recovery
         print(f"serve: session store {args.sessions} — "
@@ -387,6 +388,7 @@ def cmd_loop(args) -> None:
         stall_timeout_s=args.stall_timeout,
         max_wait_ms=args.max_wait_ms,
         seed=args.seed,
+        search_sims=args.search_sims,
     )
     overrides = parse_overrides(args.set)
     overrides.setdefault("name", "loop-learner")
@@ -568,15 +570,42 @@ def cmd_workload(args) -> None:
             zipf_s=args.zipf, seed=args.seed)
         recorder = workload_mod.configure_workload(args.out)
         fleet = _workload_engine(args)
+        searches = []
         try:
             replayed = replay_mod.WorkloadReplayer(
                 fleet, items, speed=args.speed).run()
+            if args.search:
+                # search-shaped traffic: PUCT searches rooted at the
+                # first distinct synthetic positions, leaf evals labeled
+                # search:<id> so `workload analyze` can report the
+                # transposition dup ratio the tree actually produced
+                from .search import Search, SearchConfig, game_from_packed
+
+                searcher = Search(fleet, SearchConfig(
+                    simulations=args.search_sims, tier="interactive"))
+                seen = set()
+                for item in items:
+                    if len(searches) >= args.search:
+                        break
+                    key = item["packed"].tobytes()
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    res = searcher.search(game_from_packed(
+                        item["packed"], item["player"]))
+                    searches.append({"search_id": res.search_id,
+                                     "move": res.move,
+                                     "simulations": res.simulations,
+                                     "lost": res.lost,
+                                     "wave_occupancy": res.wave_occupancy})
         finally:
             fleet.close()
             recorder.drain()
             workload_mod.disable_workload()
         stats = workload_mod.analyze_capture(args.out)
         out = {"capture": args.out, "drive": replayed, "workload": stats}
+        if searches:
+            out["searches"] = searches
         if args.json:
             print(_json.dumps(out, indent=1, default=str))
         else:
@@ -1021,6 +1050,11 @@ def main(argv=None) -> None:
                         "/metrics + /healthz — session liveness (open "
                         "sessions, WAL lag) joins the composed health "
                         "verdict (docs/robustness.md)")
+    p.add_argument("--search-sims", type=int, default=0, metavar="N",
+                   help="engine replies in --sessions games run an "
+                        "N-simulation PUCT search over the fleet "
+                        "instead of one policy argmax (0 = off; "
+                        "docs/search.md)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("loop", help="always-on expert-iteration service: "
@@ -1051,6 +1085,11 @@ def main(argv=None) -> None:
     p.add_argument("--temperature", type=float, default=0.25,
                    help="actor sampling temperature (trajectory "
                         "diversity for the corpus)")
+    p.add_argument("--search-sims", type=int, default=0, metavar="N",
+                   help="actors pick moves by N-simulation PUCT search "
+                        "over the fleet instead of one policy sample "
+                        "(0 = off; AlphaZero-style search-selfplay, "
+                        "docs/search.md)")
     p.add_argument("--window-steps", type=int, default=50,
                    help="learner steps per training window (each window "
                         "publishes one challenger)")
@@ -1252,6 +1291,14 @@ def main(argv=None) -> None:
     w.add_argument("--seed", type=int, default=0,
                    help="the trace is a pure function of this seed")
     w.add_argument("--sgf-dir", default="data/sgf/train")
+    w.add_argument("--search", type=int, default=0, metavar="N",
+                   help="after the synthetic drive, run N PUCT searches "
+                        "rooted at distinct captured positions — the "
+                        "capture gains search:<id>-labeled leaf traffic "
+                        "and `workload analyze` reports its "
+                        "transposition dup ratio")
+    w.add_argument("--search-sims", type=int, default=32, metavar="S",
+                   help="simulation budget per recorded search")
     _workload_target_args(w)
     w.set_defaults(fn=cmd_workload)
 

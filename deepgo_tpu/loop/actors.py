@@ -67,7 +67,8 @@ class SelfplayActor:
     def __init__(self, actor_id: int, buffer: ReplayBuffer, engine,
                  games_per_round: int = 8, max_moves: int = 120,
                  temperature: float = 0.25, rank: int = 8,
-                 komi: float = 7.5, seed: int = 0, metrics=None):
+                 komi: float = 7.5, seed: int = 0, metrics=None,
+                 search_sims: int = 0):
         self.actor_id = actor_id
         self.buffer = buffer
         self.engine = engine
@@ -78,6 +79,22 @@ class SelfplayActor:
         self.komi = komi
         self.seed = seed
         self._metrics = metrics
+        # search_sims > 0 upgrades the actor to AlphaZero-style
+        # search-selfplay: each move is a PUCT search over the same
+        # fleet (selfplay tier, root noise + visit temperature), so the
+        # expert-iteration corpus is produced by policy+search rather
+        # than the raw policy (docs/search.md)
+        self.search_sims = search_sims
+        self._move_selector = None
+        if search_sims > 0:
+            from ..search import SearchConfig, make_move_selector
+
+            self._move_selector = make_move_selector(
+                engine, SearchConfig(
+                    simulations=search_sims, tier="selfplay",
+                    rank=rank, max_moves=max_moves, temperature=1.0,
+                    root_noise_frac=0.25),
+                metrics=metrics)
         self.round = 0          # advances only when a round fully ingests
         self.games_acked = 0
         reg = get_registry()
@@ -103,7 +120,7 @@ class SelfplayActor:
             rank=self.rank,
             seed=int(np.random.SeedSequence(
                 (self.seed, self.actor_id, self.round)).generate_state(1)[0]),
-            engine=self.engine)
+            engine=self.engine, move_selector=self._move_selector)
         ingested = positions = 0
         for g in games:
             if not g.moves:

@@ -79,6 +79,9 @@ class LoopConfig:
     temperature: float = 0.25
     rank: int = 8
     komi: float = 7.5
+    # actors: search_sims > 0 = AlphaZero-style search-selfplay (each
+    # move a PUCT search over the fleet's selfplay tier; docs/search.md)
+    search_sims: int = 0
     # learner
     steps_per_window: int = 50
     min_window_positions: int = 512
@@ -227,7 +230,8 @@ class ExpertIterationLoop:
                           max_moves=cfg.max_moves,
                           temperature=cfg.temperature, rank=cfg.rank,
                           komi=cfg.komi, seed=cfg.seed,
-                          metrics=self.metrics)
+                          metrics=self.metrics,
+                          search_sims=cfg.search_sims)
             for i in range(cfg.actors)
         ]
 

@@ -217,6 +217,13 @@ def main(argv=None) -> None:
                          f"{GATE_OPENING_PLIES}, --seed {GATE_SEED}, "
                          f"--rank {GATE_RANK}; explicit --games/--b win "
                          "over the defaults, the protocol pins do not")
+    ap.add_argument("--search-sims", type=int, default=128, metavar="N",
+                    help="simulation budget for mcts: agents "
+                         "(deepgo_tpu.search): the pinned per-move PUCT "
+                         "budget the Elo gate quotes — "
+                         "'--a mcts:P.npz:V.npz --b value2:P.npz:V.npz "
+                         "--standard-gate --search-sims 128' is the "
+                         "search-vs-shallow gate (docs/search.md)")
     ap.add_argument("--sgf-out", help="directory to write scored games")
     ap.add_argument("--engine", action="store_true",
                     help="route net-backed agents through the shared "
@@ -270,10 +277,12 @@ def main(argv=None) -> None:
                   or args.variant_a != "f32" or args.variant_b != "f32")
     agent_a = _make_agent(args.a, args.seed, args.temperature, args.rank,
                           use_engine=use_engine, fleet=args.fleet,
-                          variant=args.variant_a)
+                          variant=args.variant_a,
+                          search_sims=args.search_sims)
     agent_b = _make_agent(args.b, args.seed + 1, args.temperature, args.rank,
                           use_engine=use_engine, fleet=args.fleet,
-                          variant=args.variant_b)
+                          variant=args.variant_b,
+                          search_sims=args.search_sims)
     # distinct names keep the A/B's win-rate keys readable when both
     # sides are the same checkpoint under different serving variants
     if args.variant_a != "f32":

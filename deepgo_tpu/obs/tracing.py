@@ -348,6 +348,7 @@ def load_trace_events(run_dir: str) -> dict:
 
     requests: list[dict] = []
     lineage: list[dict] = []
+    searches: list[dict] = []
     for name in ("trace.jsonl", "metrics.jsonl", "loop.jsonl"):
         for r in read_events(os.path.join(run_dir, name)):
             kind = r.get("kind")
@@ -355,7 +356,10 @@ def load_trace_events(run_dir: str) -> dict:
                 requests.append(r)
             elif isinstance(kind, str) and kind.startswith("lineage_"):
                 lineage.append(r)
-    return {"requests": requests, "lineage": lineage}
+            elif isinstance(kind, str) and kind.startswith("search_"):
+                searches.append(r)
+    return {"requests": requests, "lineage": lineage,
+            "searches": searches}
 
 
 def find_request(events: dict, ident: str) -> dict | None:
@@ -389,6 +393,53 @@ def format_waterfall(record: dict) -> str:
                            if k not in ("name", "t_ms"))
         lines.append(f"  +{float(e.get('t_ms', 0.0)):9.3f}ms  "
                      f"{e.get('name', '?'):<{width}}  {detail}".rstrip())
+    return "\n".join(lines)
+
+
+def find_search(events: dict, ident: str) -> dict | None:
+    """The search_request record whose search id starts with ``ident``
+    (newest wins when a short prefix is ambiguous)."""
+    hits = [r for r in events.get("searches", [])
+            if str(r.get("search_id", "")).startswith(ident)]
+    return hits[-1] if hits else None
+
+
+def _point(move) -> str:
+    if move is None or int(move) < 0:
+        return "pass"
+    x, y = divmod(int(move), 19)
+    return f"({x},{y})"
+
+
+def format_search(record: dict) -> str:
+    """The human rendering of one search verdict: the move, the anytime
+    accounting (simulations done vs lost, deadline compliance), wave
+    occupancy, and the principal variation reconstructed from the
+    tree's visit counts."""
+    head = [f"search {record.get('search_id')}  "
+            f"move={_point(record.get('move'))}"]
+    if record.get("value") is not None:
+        head.append(f"value={float(record['value']):+.4f}")
+    if record.get("tier") is not None:
+        head.append(f"tier={record['tier']}")
+    if record.get("fallback"):
+        head.append("FALLBACK")
+    lines = ["  ".join(head)]
+    lines.append(
+        f"  simulations {record.get('simulations')}  "
+        f"lost {record.get('lost', 0)}  waves {record.get('waves')}  "
+        f"occupancy {record.get('wave_occupancy')}")
+    deadline = record.get("deadline_s")
+    lines.append(
+        f"  duration {float(record.get('duration_s', 0)) * 1000:.1f}ms"
+        + (f"  deadline {float(deadline) * 1000:.0f}ms"
+           f"  met={record.get('deadline_met')}"
+           if deadline is not None else ""))
+    if record.get("digest"):
+        lines.append(f"  root digest {str(record['digest'])[:16]}")
+    pv = record.get("pv") or []
+    if pv:
+        lines.append("  pv: " + " ".join(_point(m) for m in pv))
     return "\n".join(lines)
 
 
@@ -511,6 +562,9 @@ def trace_report(run_dir: str, ident: str) -> str:
         record = find_request(events, ident)
         if record is not None:
             return format_waterfall(record)
+        search = find_search(events, ident)
+        if search is not None:
+            return format_search(search)
         chain = build_lineage(events, ident)
         if chain is not None:
             return format_lineage(chain)
@@ -525,6 +579,13 @@ def trace_report(run_dir: str, ident: str) -> str:
                 f"  {r.get('trace_id')}  "
                 f"{float(r.get('duration_s', 0)) * 1000:9.3f}ms  "
                 f"status={r.get('status')}  hops={len(r.get('hops', []))}")
+    if events.get("searches"):
+        lines.append("search verdicts:")
+        for r in events["searches"][-10:]:
+            lines.append(
+                f"  {r.get('search_id')}  move={_point(r.get('move'))}  "
+                f"sims={r.get('simulations')}  "
+                f"{float(r.get('duration_s', 0)) * 1000:8.1f}ms")
     if events["lineage"]:
         windows = [r for r in events["lineage"]
                    if r["kind"] == "lineage_window"]

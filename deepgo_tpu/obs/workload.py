@@ -488,14 +488,19 @@ def characterize(requests: list[dict]) -> dict:
     by_outcome: dict[str, int] = {}
     by_session: dict[str, list[float]] = {}
     latencies: list[float] = []
+    search_canon: dict[str, int] = {}
+    search_sessions: set[str] = set()
     for r in requests:
         d = r.get("digest")
         c = r.get("canonical", d)
         exact[d] = exact.get(d, 0) + 1
         canon[c] = canon.get(c, 0) + 1
         if r.get("session") is not None:
-            by_session.setdefault(str(r["session"]), []).append(
-                float(r.get("t", 0.0)))
+            sid = str(r["session"])
+            by_session.setdefault(sid, []).append(float(r.get("t", 0.0)))
+            if sid.startswith("search:"):
+                search_canon[c] = search_canon.get(c, 0) + 1
+                search_sessions.add(sid)
         tier = str(r.get("tier") or "untiered")
         by_tier[tier] = by_tier.get(tier, 0) + 1
         if r.get("bucket") is not None:
@@ -551,6 +556,21 @@ def characterize(requests: list[dict]) -> dict:
                           for b in sorted(by_bucket, key=int)}
     if by_session:
         out["sessions"] = _characterize_sessions(by_session)
+    if search_canon:
+        # the search-shaped slice: leaf evaluations labeled
+        # ``search:<id>`` by the PUCT searcher. The transposition dup
+        # ratio is how much of the search's leaf traffic the
+        # transposition table / canonical cache serves for free —
+        # the measured justification for keying the tree on the
+        # content-addressed digests (docs/search.md)
+        s_total = sum(search_canon.values())
+        out["search"] = {
+            "requests": s_total,
+            "searches": len(search_sessions),
+            "canonical_unique": len(search_canon),
+            "transposition_dup_ratio": round(
+                1.0 - len(search_canon) / s_total, 4),
+        }
     if interarrival is not None:
         out["interarrival"] = interarrival
     if latencies:
@@ -616,6 +636,13 @@ def format_workload(stats: dict) -> str:
             f"sessions: {sess['count']} distinct  "
             f"{sess['labeled_requests']} labeled requests  "
             + "  ".join(parts))
+    search = stats.get("search")
+    if search:
+        lines.append(
+            f"search: {search['requests']} leaf evals across "
+            f"{search['searches']} searches  canonical "
+            f"{search['canonical_unique']}  transposition dup ratio "
+            f"{search['transposition_dup_ratio']:.2%}")
     for name in ("tiers", "buckets", "outcomes"):
         mix = stats.get(name)
         if mix:

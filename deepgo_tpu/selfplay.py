@@ -212,7 +212,8 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
               max_moves: int = 361, temperature: float = 0.0,
               pass_threshold: float = 1e-4, rank: int = 9, seed: int = 0,
               engine=None, max_wait_ms: float = 2.0,
-              supervised: bool = False, fleet: int = 0):
+              supervised: bool = False, fleet: int = 0,
+              move_selector=None):
     """Play n_games to completion; returns (games, stats).
 
     Inference rides the micro-batching engine (deepgo_tpu.serving): each
@@ -236,6 +237,14 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
     occupancy/latency/bucket counters (plus the supervisor's
     restart/shed/poison counters when supervised, or the fleet's
     failover/respawn/shed counters with ``fleet``).
+
+    ``move_selector`` replaces the per-row policy sampling entirely —
+    AlphaZero-style search-selfplay
+    (deepgo_tpu.search.make_move_selector): called as
+    ``move_selector(games, packed, players, legal, rng)`` and returning
+    one move index per active game (-1 = pass). The selector owns its
+    own inference traffic (the search's wave-batched leaf futures), so
+    the per-game policy submission loop is skipped.
     """
     own_engine = engine is None
     if own_engine:
@@ -276,23 +285,28 @@ def self_play(params, cfg: policy_cnn.ModelConfig, n_games: int = 32,
                 break
             packed = summarize_states(active)
             players = np.array([g.player for g in active], dtype=np.int32)
-
-            # every game is an independent submitter: futures out, one
-            # coalesced dispatch behind them
-            futures = [engine.submit(packed[i], int(players[i]), rank)
-                       for i in range(len(active))]
-            logp = np.stack([f.result() for f in futures])
+            legal = legal_mask(packed, players, active)
             positions += len(active)
             obs_positions.inc(len(active))
             obs_games.set(len(active))
 
-            legal = legal_mask(packed, players, active)
-            logp = np.where(legal, logp, -np.inf)
+            if move_selector is not None:
+                # search-selfplay: the selector runs its own tree search
+                # per game (its leaf futures are the inference traffic)
+                moves = [int(m) for m in
+                         move_selector(active, packed, players, legal, rng)]
+            else:
+                # every game is an independent submitter: futures out,
+                # one coalesced dispatch behind them
+                futures = [engine.submit(packed[i], int(players[i]), rank)
+                           for i in range(len(active))]
+                logp = np.stack([f.result() for f in futures])
+                logp = np.where(legal, logp, -np.inf)
+                moves = [select_from_log_probs(logp[i], temperature,
+                                               pass_threshold, rng)
+                         for i in range(len(active))]
 
-            step_games(active, [
-                select_from_log_probs(logp[i], temperature, pass_threshold,
-                                      rng)
-                for i in range(len(active))], max_moves)
+            step_games(active, moves, max_moves)
 
         dt = time.time() - t0
         obs_rate.set(positions / dt)

@@ -210,7 +210,7 @@ class _FleetRequest:
     __slots__ = ("packed", "player", "rank", "tier", "deadline", "future",
                  "excluded", "failovers", "t_submit", "t_first_failure",
                  "last_error", "trace", "workload", "placed", "inners",
-                 "hedge_state", "hedge_idx")
+                 "hedge_state", "hedge_idx", "parked")
 
     def __init__(self, packed, player, rank, tier, deadline, t_submit,
                  trace=None, workload=None):
@@ -231,6 +231,7 @@ class _FleetRequest:
         self.inners: dict[int, Future] = {}  # replica idx -> inner future
         self.hedge_state: str | None = None  # None|scheduled|launched
         self.hedge_idx: int | None = None    # the hedge copy's replica
+        self.parked = False               # waiting out a respawn in flight
 
 
 class _Replica:
@@ -308,6 +309,8 @@ class FleetRouter:
         self._ejections = 0
         self._integrity_failures = 0
         self._respawn_threads: list[threading.Thread] = []
+        self._parked: list[_FleetRequest] = []
+        self._parks = 0
         self._shed = {t: 0 for t in TIERS}
         self._tier_lat: dict[str, deque] = {t: deque(maxlen=4096)
                                             for t in TIERS}
@@ -347,6 +350,11 @@ class FleetRouter:
         self._obs_integrity = reg.counter(
             "deepgo_fleet_integrity_failures_total",
             "responses rejected by the fleet integrity check")
+        self._obs_parks = reg.counter(
+            "deepgo_fleet_parks_total",
+            "unroutable requests parked to wait out a respawn in flight "
+            "instead of resolving typed exhaustion against a fleet that "
+            "is only temporarily below strength")
         self._obs_breaker = reg.gauge(
             "deepgo_fleet_breaker_state",
             "per-replica circuit breaker: 0 closed, 1 half-open, 2 open")
@@ -446,6 +454,11 @@ class FleetRouter:
                 break
             if kind == "failover" and not payload.future.done():
                 payload.future.set_exception(exc)
+        with self._lock:
+            parked, self._parked = self._parked, []
+        for req in parked:
+            if not req.future.done():
+                req.future.set_exception(exc)
         if self.cache is not None:
             # failing the queued internal leaders above already walked
             # complete_err/promotion for most flights; this sweep catches
@@ -780,10 +793,14 @@ class FleetRouter:
                             shed_error: BaseException | None) -> None:
         """Every candidate is gone: a shed if replicas shed us, typed
         exhaustion if this request already fled failures, else the fleet
-        is simply down."""
+        is simply down — UNLESS a respawn is in flight and the deadline
+        still has headroom, in which case the request parks and the
+        router re-dispatches it when the rebuild lands."""
         if shed_error is not None:
             self._count_shed(req.tier, "replicas")
             self._resolve(req, exc=shed_error)
+        elif self._park(req):
+            return
         elif req.failovers > 0:
             err = FailoverExhausted(
                 f"FleetRouter[{self.name}] request failed over "
@@ -795,6 +812,51 @@ class FleetRouter:
             self._resolve(req, exc=FleetUnavailable(
                 f"FleetRouter[{self.name}] has no serving replica "
                 f"({self._serving_count()}/{len(self._replicas)} serving)"))
+
+    def _park(self, req: _FleetRequest) -> bool:
+        """Park one unroutable request while any replica is mid-respawn
+        (the PR 12 fleet-2 chaos fix): the fleet is temporarily below
+        strength, not down, so resolving FailoverExhausted /
+        FleetUnavailable here burns a typed error against capacity that
+        is seconds from returning. Parked requests are re-dispatched by
+        the router when a respawn lands or gives up (``"respawned"``
+        events), and swept on idle ticks so a lapsed deadline resolves
+        its TimeoutError promptly; ``close()`` drains the parking lot
+        with EngineClosed — no stranded waiters."""
+        if self._closing.is_set():
+            return False
+        if req.deadline is not None and self._clock() >= req.deadline:
+            return False
+        with self._lock:
+            respawning = sum(r.state == "respawning"
+                             for r in self._replicas)
+            if not respawning:
+                return False
+            req.parked = True
+            self._parked.append(req)
+            self._parks += 1
+        self._obs_parks.inc(fleet=self.name)
+        if req.trace is not None:
+            req.trace.mark("parked", respawning=respawning)
+        return True
+
+    def _unpark(self, rep: _Replica | None = None,
+                respawned: bool = False) -> None:
+        """Re-dispatch every parked request (router thread only). A
+        respawn that LANDED also clears the fresh replica from each
+        parked request's exclusion set — the rebuilt engine is not the
+        corpse the request fled. Requests that are still unroutable and
+        still covered by another in-flight respawn simply park again;
+        lapsed deadlines resolve TimeoutError inside ``_dispatch``."""
+        with self._lock:
+            parked, self._parked = self._parked, []
+        for req in parked:
+            req.parked = False
+            if req.future.done():
+                continue
+            if respawned and rep is not None:
+                req.excluded.discard(rep.idx)
+            self._dispatch(req, block=True)
 
     def _note_failure(self, req: _FleetRequest, rep: _Replica,
                       exc: BaseException) -> None:
@@ -809,9 +871,28 @@ class FleetRouter:
             req.t_first_failure = self._clock()
         with self._lock:
             self._failovers += 1
+            respawning = sum(r.state == "respawning"
+                             for r in self._replicas)
+            rep_serving = rep.state == "serving"
         self._obs_failovers.inc(fleet=self.name)
         self._events.put(("check", rep))
-        if req.failovers > self.config.max_failovers:
+        # the budget is respawn-aware (the PR 12 fleet-2 chaos flake):
+        # hops burned while replicas are mid-rebuild are hops against a
+        # fleet TEMPORARILY below strength, not against this request —
+        # each respawn in flight widens the budget by one, and once the
+        # rebuilds land (or give up) the configured bound is back
+        if (rep_serving
+                and req.failovers > self.config.max_failovers + respawning):
+            # the corpse that just failed us may not have flipped to
+            # "respawning" yet (its check event is queued, not yet
+            # processed): peek at the engine so the budget widens on the
+            # same failure that killed the replica, not one hop later
+            try:
+                if rep.engine.health().get("state") in ("failed", "closed"):
+                    respawning += 1
+            except Exception:  # noqa: BLE001 — a corpse that can't even
+                respawning += 1  # report health is certainly dead
+        if req.failovers > self.config.max_failovers + respawning:
             err = FailoverExhausted(
                 f"FleetRouter[{self.name}] request exhausted its failover "
                 f"budget ({self.config.max_failovers}); last error: {exc!r}")
@@ -1043,6 +1124,8 @@ class FleetRouter:
                 self._dispatch(payload, block=True)
             elif kind == "check":
                 self._check_replica(payload)
+            elif kind == "respawned":
+                self._unpark(*payload)
 
     def _scan_replicas(self) -> None:
         for rep in self._replicas:
@@ -1050,6 +1133,7 @@ class FleetRouter:
         if self.config.eject_stragglers:
             self._eject_outliers()
         self._update_breaker_gauge()
+        self._unpark()  # deadline sweep for the parking lot
 
     def _check_replica(self, rep: _Replica) -> None:
         with self._lock:
@@ -1187,6 +1271,10 @@ class FleetRouter:
                     self._metrics.write(
                         "fleet_replica_failed", fleet=self.name,
                         replica=rep.idx, respawns=rep.respawns)
+                # a respawn giving up still wakes the parking lot: with
+                # no rebuild left in flight the parked requests resolve
+                # their typed exhaustion instead of waiting for a tick
+                self._events.put(("respawned", (rep, False)))
                 return
             # backoff waits on the closing event, not a bare sleep, so a
             # concurrent close() interrupts the wait instead of hanging
@@ -1227,6 +1315,10 @@ class FleetRouter:
                                     replica=rep.idx,
                                     attempt=rep.consec_respawns,
                                     total_respawns=total)
+            # the landed respawn re-dispatches the parking lot, and the
+            # fresh engine gets a clean slate in each parked request's
+            # exclusion set (it is not the corpse the request fled)
+            self._events.put(("respawned", (rep, True)))
             return
 
     # -- hot weight reload -------------------------------------------------
@@ -1368,6 +1460,7 @@ class FleetRouter:
                 "hedge_wins": self._hedge_wins,
                 "ejections": self._ejections,
                 "integrity_failures": self._integrity_failures,
+                "parks": self._parks,
                 "shed": dict(self._shed),
             }
 

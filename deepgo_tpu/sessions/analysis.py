@@ -49,7 +49,8 @@ class SgfAnalysisService:
                  timeout_s: float = 0.5, attempts: int = 2,
                  collect_timeout_s: float = 30.0,
                  blunder_top: int = 10, blunder_logp: float = -4.0,
-                 sleep=time.sleep, rng: random.Random | None = None):
+                 sleep=time.sleep, rng: random.Random | None = None,
+                 search_sims: int = 0, search_config=None):
         self.fleet = fleet
         self.out_dir = out_dir
         os.makedirs(out_dir, exist_ok=True)
@@ -61,6 +62,19 @@ class SgfAnalysisService:
         self.blunder_logp = float(blunder_logp)
         self._sleep = sleep
         self._rng = rng or random.Random(0)
+        # search_sims > 0 adds a second-opinion PUCT search on every
+        # blunder-flagged move: the annotation gains the search's
+        # preferred point and visit count, still on the batch tier so
+        # deep verdicts coexist with interactive traffic the same way
+        # the plain scan does
+        self._searcher = None
+        if search_sims > 0 or search_config is not None:
+            from ..search import Search, SearchConfig
+
+            cfg = search_config or SearchConfig(
+                simulations=search_sims, tier=tier,
+                eval_timeout_s=collect_timeout_s)
+            self._searcher = Search(fleet, cfg)
         self.cursor_path = os.path.join(out_dir, "cursor.json")
         self.sink = JsonlSink(os.path.join(out_dir, "annotations.jsonl"),
                               buffering=1 << 16)
@@ -112,6 +126,23 @@ class SgfAnalysisService:
                 self._sleep(full_jitter_delay(attempt, 0.01, 0.1,
                                               self._rng))
         return None, last_outcome
+
+    def _search_verdict(self, packed, player: int) -> dict:
+        """Search fields for a blunder annotation, or a marker when the
+        search itself was shed — the scan never stalls on a verdict."""
+        from ..search import game_from_packed
+
+        try:
+            res = self._searcher.search(game_from_packed(packed, player))
+        except Exception:  # noqa: BLE001 — verdicts are best-effort
+            return {"search_move": None}
+        if res.move < 0:
+            return {"search_move": None,
+                    "search_value": round(float(res.value), 4)}
+        sx, sy = divmod(int(res.move), 19)
+        return {"search_move": [sx, sy],
+                "search_value": round(float(res.value), 4),
+                "search_simulations": res.simulations}
 
     # -- the scan ----------------------------------------------------------
 
@@ -198,11 +229,14 @@ class SgfAnalysisService:
                 move_rank = int((row > logp).sum()) + 1
                 blunder = (move_rank > self.blunder_top
                            and logp < self.blunder_logp)
-                self.sink.write(
-                    "session_annotation", file=rel, move=i,
-                    player=int(move.player), x=int(move.x),
-                    y=int(move.y), logp=round(logp, 6), rank=move_rank,
-                    blunder=blunder)
+                record = dict(
+                    file=rel, move=i, player=int(move.player),
+                    x=int(move.x), y=int(move.y), logp=round(logp, 6),
+                    rank=move_rank, blunder=blunder)
+                if blunder and self._searcher is not None:
+                    record.update(self._search_verdict(positions[i][0],
+                                                       int(move.player)))
+                self.sink.write("session_annotation", **record)
                 count("annotated")
                 annotated += 1
                 blunders += blunder

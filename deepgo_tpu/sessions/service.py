@@ -15,7 +15,11 @@ Replies are DETERMINISTIC — argmax of the policy logits over the
 game's legal points (suicide/superko/occupied already excluded), pass
 when no legal point remains — so a resumed server replays to the same
 game as an uninterrupted one. Requests are stamped with the ``session``
-label for the workload observatory.
+label for the workload observatory. With ``search_sims > 0`` the reply
+is instead a batched PUCT search (deepgo_tpu.search) whose leaf
+evaluations ride the same fleet tier; the search's anytime contract
+returns a legal move within the final deadline tier, and any search
+failure degrades to the plain argmax path rather than losing the move.
 """
 
 from __future__ import annotations
@@ -58,7 +62,8 @@ class GameService:
     def __init__(self, fleet, store: SessionStore,
                  tier: str = "interactive",
                  budgets_s: tuple = DEFAULT_BUDGETS_S, rank: int = 5,
-                 sleep=time.sleep, rng: random.Random | None = None):
+                 sleep=time.sleep, rng: random.Random | None = None,
+                 search_sims: int = 0, search_config=None, metrics=None):
         if not budgets_s:
             raise ValueError("budgets_s needs at least one deadline tier")
         self.fleet = fleet
@@ -68,6 +73,21 @@ class GameService:
         self.rank = int(rank)
         self._sleep = sleep
         self._rng = rng or random.Random(0)
+        # search_sims > 0 puts a PUCT search (deepgo_tpu.search) behind
+        # every engine reply: leaf evaluations ride the same fleet on
+        # the interactive tier, the reply deadline is the LAST budget
+        # tier (the anytime contract absorbs mid-search failures the
+        # retry ladder would otherwise pay for), and the move is still
+        # deterministic and always legal for the session's superko rules
+        # (the search only picks inside the game's own legal set)
+        self._searcher = None
+        if search_sims > 0 or search_config is not None:
+            from ..search import Search, SearchConfig
+
+            cfg = search_config or SearchConfig(
+                simulations=search_sims, tier=tier, rank=int(rank),
+                deadline_s=self.budgets_s[-1])
+            self._searcher = Search(fleet, cfg, metrics=metrics)
         self._lock = make_lock("sessions.service")
         self._opened = 0
         self.reply_retries = 0
@@ -136,18 +156,48 @@ class GameService:
             return {"session": session_id, "seq": seq, "player": player,
                     "pass": True, "over": game.over}
         packed = summarize(game.stones, game.age)
-        row = self._forward(session_id, packed, player)
-        masked = np.full(SIZE * SIZE, -np.inf, dtype=np.float64)
         idx = np.array([x * SIZE + y for x, y in legal], dtype=np.int64)
-        masked[idx] = np.asarray(row, dtype=np.float64).reshape(-1)[idx]
-        pick = int(masked.argmax())
+        pick, extra = -1, {}
+        if self._searcher is not None:
+            pick, extra = self._search_reply(packed, player, idx)
+        if pick < 0 or not (0 <= pick < SIZE * SIZE) or pick not in idx:
+            row = self._forward(session_id, packed, player)
+            masked = np.full(SIZE * SIZE, -np.inf, dtype=np.float64)
+            masked[idx] = np.asarray(row,
+                                     dtype=np.float64).reshape(-1)[idx]
+            pick = int(masked.argmax())
         x, y = divmod(pick, SIZE)
         seq = self.store.append_move(session_id, player, x=x, y=y,
                                      elapsed_s=elapsed_s)
         self._obs_moves.inc(source="engine")
         self.replies += 1
-        return {"session": session_id, "seq": seq, "player": player,
-                "x": x, "y": y, "over": game.over}
+        out = {"session": session_id, "seq": seq, "player": player,
+               "x": x, "y": y, "over": game.over}
+        out.update(extra)
+        return out
+
+    def _search_reply(self, packed, player: int, idx) -> tuple[int, dict]:
+        """One PUCT search for the reply move. The session's own legal
+        set (superko-aware) is the root mask, so the search can only
+        pick moves the game accepts; any search failure degrades to the
+        plain deadline-tiered argmax path rather than losing the move."""
+        from ..search import game_from_packed
+
+        root_legal = np.zeros(SIZE * SIZE, dtype=bool)
+        root_legal[idx] = True
+        try:
+            res = self._searcher.search(game_from_packed(packed, player),
+                                        root_legal=root_legal)
+        except Exception:  # noqa: BLE001 — anytime: argmax still replies
+            self._obs_replies.inc(outcome="search_failed")
+            return -1, {}
+        self._obs_replies.inc(outcome="search")
+        extra = {"search": {"search_id": res.search_id,
+                            "value": round(float(res.value), 4),
+                            "simulations": res.simulations,
+                            "deadline_met": res.deadline_met,
+                            "pv": res.pv[:8]}}
+        return int(res.move), extra
 
     def _forward(self, session_id: str, packed, player: int):
         """One policy forward under deadline-tiered budgets. Absorbable

@@ -326,6 +326,55 @@ class TestFailover:
         finally:
             fleet.close()
 
+    def test_respawn_in_flight_widens_the_failover_budget(self):
+        """The PR 12 fleet-2 chaos flake: a request whose hops land while
+        the fleet is temporarily below strength (a replica mid-respawn)
+        must NOT burn typed exhaustion against the missing capacity —
+        with a zero failover budget and a single dying replica, the
+        request parks, rides out the rebuild, and resolves with the
+        correct row."""
+        faults.install("serving_dispatch:fail@1")
+        cfg = FleetConfig(max_failovers=0, respawn_base_s=0.01,
+                          respawn_cap_s=0.02)
+        fleet = make_fleet(replicas=1, sup_config=DIE_FAST,
+                           fleet_config=cfg)
+        try:
+            packed, players, ranks = boards(1, seed=21)
+            f = fleet.submit(packed[0], int(players[0]), int(ranks[0]))
+            got = np.atleast_1d(f.result(timeout=20))[0]
+            assert got == ok_forward(None, packed, players, ranks)[0], \
+                "the request must ride the respawn, not exhaust against it"
+            h = fleet.health()
+            assert h["failovers"] >= 1
+            assert h["respawns"] >= 1
+        finally:
+            fleet.close()
+
+    def test_unroutable_request_parks_until_the_respawn_lands(self):
+        """A submit arriving while the only replica is mid-respawn parks
+        (counted) instead of resolving FleetUnavailable, and the landed
+        rebuild re-dispatches it."""
+        faults.install("serving_dispatch:fail@1")
+        cfg = FleetConfig(max_failovers=0, respawn_base_s=0.05,
+                          respawn_cap_s=0.1)
+        fleet = make_fleet(replicas=1, sup_config=DIE_FAST,
+                           fleet_config=cfg)
+        try:
+            packed, players, ranks = boards(2, seed=22)
+            f0 = fleet.submit(packed[0], int(players[0]), int(ranks[0]))
+            # wait for the death to be noticed, then submit INTO the hole
+            assert wait_until(
+                lambda: fleet.health()["replicas_serving"] == 0
+                or f0.done(), timeout=10)
+            f1 = fleet.submit(packed[1], int(players[1]), int(ranks[1]))
+            exp = ok_forward(None, packed, players, ranks)
+            assert np.atleast_1d(f0.result(timeout=20))[0] == exp[0]
+            assert np.atleast_1d(f1.result(timeout=20))[0] == exp[1]
+            assert fleet.health()["parks"] >= 1, \
+                "the below-strength window never parked a request"
+        finally:
+            fleet.close()
+
     def test_single_replica_death_is_down_then_unavailable(self):
         faults.install("serving_dispatch:fail@1")
         cfg = FleetConfig(max_respawns=0, respawn_base_s=0.001,
